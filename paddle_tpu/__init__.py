@@ -1,0 +1,92 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of the
+reference framework (PaddlePaddle ~v2.1, see SURVEY.md): eager-first tensors
+with tape autograd, a jit-traced "static" mode, a broad nn/optimizer/data
+stack, AMP, checkpointing, and mesh-based distributed training (dp/tp/pp/
+sharding/sp) over XLA collectives.
+
+Public namespace mirrors `paddle.*`.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Parameter,
+    Place,
+    TPUPlace,
+    Tensor,
+    device_count,
+    enable_grad,
+    get_device,
+    get_flags,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_device,
+    set_flags,
+    set_grad_enabled,
+)
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.random import get_rng_state, set_rng_state  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import profiler as _profiler_mod  # noqa: F401
+from . import incubate  # noqa: F401
+
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary  # noqa: F401
+from .nn.layer import Layer  # noqa: F401
+from .autograd.functional import grad  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+# `paddle.nn.functional` style import convenience
+from .nn import functional as _F  # noqa: F401
+
+
+def disable_static(place=None):
+    """Parity shim: this framework is always eager-first."""
+    return None
+
+
+def enable_static():
+    from .static import _enable_static_mode
+    _enable_static_mode()
+
+
+def in_dynamic_mode() -> bool:
+    from .static import _in_static_mode
+    return not _in_static_mode()

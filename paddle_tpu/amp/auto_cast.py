@@ -1,0 +1,123 @@
+"""AMP autocast.
+
+Reference: imperative AMP lists (paddle/fluid/imperative/amp_auto_cast.h:38-66,
+AutoCastInputs O1 / CastPureFp16Inputs O2) and python amp/auto_cast.py.
+
+TPU-native: bf16 is the default low precision (no loss scaling needed);
+fp16 kept for parity. O1 casts inputs of allow-listed ops; O2 runs the whole
+region in low precision except block-listed ops. Implemented as a context
+that installs a cast policy consulted by core.tensor.apply via an op-name
+filter wrapper around the nn functional layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+
+# Ops whose inputs are cast to low precision in O1 (MXU-bound ops).
+white_list = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "scaled_dot_product_attention", "einsum",
+}
+
+# Ops kept in fp32 even under O2 (numerically sensitive).
+black_list = {
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "norm",
+    "mean", "sum", "exp", "log", "logsumexp", "erf", "erfinv", "pow",
+    "cumsum", "rsqrt", "sqrt", "square",
+}
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enabled, dtype, level, custom_white, custom_black):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = custom_white or set()
+        self.custom_black = custom_black or set()
+
+
+def amp_state():
+    return getattr(_tls, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast analogue (bf16-first on TPU)."""
+    prev = amp_state()
+    _tls.amp = _AmpState(enable, dtypes.convert_dtype(dtype), level,
+                         set(custom_white_list or []), set(custom_black_list or []))
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Called by the dispatch layer: cast raw arrays per the active policy."""
+    st = amp_state()
+    if st is None or not st.enabled:
+        return arrays
+    low = st.dtype
+    wl = (white_list | st.custom_white) - st.custom_black
+    bl = black_list | st.custom_black
+    if st.level == "O2":
+        if op_name in bl:
+            target = jnp.float32
+        else:
+            target = low
+    else:  # O1
+        if op_name in wl:
+            target = low
+        elif op_name in bl:
+            target = jnp.float32
+        else:
+            return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+from ..core.tensor import set_amp_hook  # noqa: E402
+
+set_amp_hook(amp_cast_inputs)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low precision, keep fp32 master
+    weights inside the optimizer (reference: amp/auto_cast.py decorate)."""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(optimizers, (list, tuple)) else optimizers
+            for o in opts:
+                o._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers

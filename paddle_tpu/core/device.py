@@ -1,0 +1,115 @@
+"""Device model.
+
+TPU-native replacement for the reference's Place variants
+(reference: paddle/fluid/platform/place.h:26-95) and DeviceContextPool
+(device_context.h:818). On TPU, XLA owns streams/contexts; what remains is a
+thin `Place` naming scheme over `jax.Device` plus a process-wide default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+
+
+class Place:
+    """A device place: ``tpu:0``, ``cpu``, ``tpu`` (first chip)."""
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.index == other.index
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = _devices_by_kind(self.kind)
+        if self.index >= len(devs):
+            raise RuntimeError(
+                f"Place {self} out of range: only {len(devs)} {self.kind} device(s)."
+            )
+        return devs[self.index]
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, index: int = 0):
+        super().__init__("tpu", index)
+
+
+# Aliases for reference-API parity (CUDAPlace users map to the accelerator).
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+NPUPlace = TPUPlace
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_by_kind(kind: str):
+    if kind == "cpu":
+        return jax.devices("cpu")
+    # "tpu" means the default accelerator backend (tpu chip; on test rigs the
+    # backend may be cpu-only — fall back so code is portable).
+    try:
+        return jax.devices()
+    except RuntimeError:
+        return jax.devices("cpu")
+
+
+_DEFAULT_DEVICE: list = []
+
+
+def _parse(device: Union[str, Place]) -> Place:
+    if isinstance(device, Place):
+        return device
+    if ":" in device:
+        kind, idx = device.split(":")
+        return Place(kind, int(idx))
+    return Place(device, 0)
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    place = _parse(device)
+    _DEFAULT_DEVICE[:] = [place]
+    return place
+
+
+def get_device() -> str:
+    place = default_place()
+    return f"{place.kind}:{place.index}"
+
+
+def default_place() -> Place:
+    if _DEFAULT_DEVICE:
+        return _DEFAULT_DEVICE[0]
+    backend = jax.default_backend()
+    kind = "tpu" if backend != "cpu" else "cpu"
+    return Place(kind, 0)
+
+
+def is_compiled_with_cuda() -> bool:  # parity shim
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return jax.default_backend() not in ("cpu",)
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,79 @@
+"""Dtype registry.
+
+Maps the reference's dtype enum (reference: paddle/fluid/framework/framework.proto:117-187)
+onto native jax/numpy dtypes. bfloat16 is first-class on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are numpy dtypes (jnp uses them natively).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalise str / np.dtype / jnp dtype to a canonical dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unsupported dtype string: {dtype!r}")
+    return np.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_to_str(dtype) -> str:
+    name = np.dtype(dtype).name
+    return name
+
+
+def is_floating_point(dtype) -> bool:
+    return np.dtype(dtype).kind == "f" or dtype == bfloat16
+
+
+def is_integer(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(dtype):
+    _DEFAULT_DTYPE[0] = convert_dtype(dtype)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]

@@ -1,0 +1,86 @@
+"""Global configuration flags.
+
+TPU-native analogue of the reference's three-tier flag system
+(reference: paddle/fluid/platform/flags.cc — 48 gflags settable via env
+``FLAGS_*`` and ``paddle.set_flags``; pybind/global_value_getter_setter.cc).
+
+Here flags live in a single registry; values are read from the environment
+(``FLAGS_<name>``) at first access and can be overridden with
+:func:`set_flags` / read with :func:`get_flags`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+    value: Any = None
+    explicitly_set: bool = False
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    _REGISTRY[name] = _Flag(name, default, help, parser)
+
+
+def get_flag(name: str) -> Any:
+    flag = _REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(f"Unknown flag: {name!r}")
+    if flag.explicitly_set:
+        return flag.value
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        return flag.parser(env)
+    return flag.default
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags analogue."""
+    for name, value in flags.items():
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise KeyError(f"Unknown flag: {name!r}")
+        flag.value = value
+        flag.explicitly_set = True
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: get_flag(n) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (TPU-relevant subset of the reference's platform/flags.cc)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after each eager op.")
+define_flag("benchmark", False, "Synchronize after each op for benchmarking.")
+define_flag("eager_jit_ops", True, "Cache-jit elementary eager ops.")
+define_flag("default_dtype", "float32", "Default floating dtype.")
+define_flag("allocator_strategy", "xla", "Kept for API parity; XLA owns HBM on TPU.")
+define_flag("check_finite", False, "Check gradients finite after backward.")
+define_flag("tpu_matmul_precision", "default", "jax default_matmul_precision.")
+define_flag("log_level", "0", "Verbose log level (VLOG analogue).")

@@ -1,0 +1,554 @@
+"""Tensor facade and eager autograd engine.
+
+TPU-native redesign of the reference's imperative engine:
+
+- ``Tensor`` replaces ``VarBase`` (reference: paddle/fluid/imperative/layer.h) —
+  a thin facade over ``jax.Array`` carrying ``stop_gradient``, ``.grad`` and a
+  tape node.
+- Eager op execution replaces ``Tracer::TraceOp``
+  (reference: paddle/fluid/imperative/tracer.cc:146): every differentiable op
+  goes through :func:`apply`, which computes the primal and records a
+  ``jax.vjp`` closure on the tape — the per-op grad-node construction the
+  reference does with DygraphGradOpMaker (imperative/layer.cc:492) falls out
+  of JAX's functional VJP for free.
+- ``Tensor.backward()`` replaces ``BasicEngine``
+  (reference: paddle/fluid/imperative/basic_engine.cc:39-636): dependency
+  counting + topological queue + gradient accumulation.
+
+Under ``jit`` tracing the same ops run on tracer arrays with the tape
+disabled; gradients there come from functional transforms instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .device import Place, default_place
+from .flags import get_flag
+
+__all__ = [
+    "Tensor",
+    "apply",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "Parameter",
+]
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class _GradModeCtx:
+    """Context manager / decorator toggling eager tape recording."""
+
+    def __init__(self, mode: bool):
+        self.mode = mode
+
+    def __enter__(self):
+        self.prev = is_grad_enabled()
+        set_grad_enabled(self.mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self.prev)
+        return False
+
+    def __call__(self, fn=None):
+        if fn is None:
+            return self
+
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeCtx(self.mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad(fn=None):
+    ctx = _GradModeCtx(False)
+    return ctx(fn) if fn is not None else ctx
+
+
+def enable_grad(fn=None):
+    ctx = _GradModeCtx(True)
+    return ctx(fn) if fn is not None else ctx
+
+
+class TapeNode:
+    """One recorded differentiable op (analogue of GradOpNode)."""
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "out_refs",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        # Tensors (diff inputs only, positionally matching vjp cotangents).
+        self.inputs: List["Tensor"] = inputs
+        self.out_avals = out_avals  # list[ShapeDtypeStruct]
+        self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_avals)
+        self.name = name
+
+    def __repr__(self):
+        return f"TapeNode({self.name}, n_in={len(self.inputs)}, n_out={len(self.out_avals)})"
+
+
+def _is_floating(arr) -> bool:
+    d = arr.dtype
+    return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating)
+
+
+class Tensor:
+    """Eager tensor over a jax.Array.
+
+    ``stop_gradient`` defaults to True (reference semantics: plain tensors
+    don't require grad; ``Parameter`` flips it).
+    """
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_retain_grads", "_hooks", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        dtype = dtypes.convert_dtype(dtype)
+        if isinstance(data, (jax.Array,)) or _is_tracer(data):
+            arr = data if dtype is None else data.astype(dtype)
+        else:
+            np_data = np.asarray(data)
+            if dtype is None and np_data.dtype == np.float64:
+                np_data = np_data.astype(np.float32)
+            arr = jnp.asarray(np_data, dtype=dtype)
+        if place is not None and not _is_tracer(arr):
+            arr = jax.device_put(arr, place.jax_device if isinstance(place, Place) else place)
+        self._data = arr
+        self.stop_gradient = bool(stop_gradient)
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[TapeNode] = None
+        self._out_idx: int = 0
+        self.name = name
+        self.persistable = False
+        self._retain_grads = False
+        self._hooks: List[Callable] = []
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = self._data.devices().pop()
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return default_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_to_str(self.dtype)}"
+            f"{grad_part},\n       {np.asarray(self._data)!r})"
+        )
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        """Copy value + flags; tape state never survives a deepcopy."""
+        cls = type(self)
+        new = cls.__new__(cls)
+        new._data = self._data
+        new.stop_gradient = self.stop_gradient
+        new.grad = None
+        new._node = None
+        new._out_idx = 0
+        new.name = self.name
+        new.persistable = self.persistable
+        new._retain_grads = False
+        new._hooks = []
+        for slot in getattr(cls, "__slots__", ()):
+            if slot in Tensor.__slots__ or slot == "__weakref__":
+                continue
+            if hasattr(self, slot):
+                import copy as _copy
+                setattr(new, slot, _copy.deepcopy(getattr(self, slot), memo))
+        memo[id(self)] = new
+        return new
+
+    # -- conversions --------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        dtype = dtypes.convert_dtype(dtype)
+        return apply(lambda x: x.astype(dtype), self, name="cast")
+
+    cast = astype
+
+    def clone(self) -> "Tensor":
+        return apply(lambda x: x + 0, self, name="clone")
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, device=None, dtype=None) -> "Tensor":
+        arr = self._data
+        if dtype is not None:
+            arr = arr.astype(dtypes.convert_dtype(dtype))
+        if device is not None:
+            from .device import _parse
+            arr = jax.device_put(arr, _parse(device).jax_device)
+        t = Tensor(arr, stop_gradient=self.stop_gradient)
+        return t
+
+    def pin_memory(self) -> "Tensor":  # host staging is implicit on TPU
+        return self
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def retain_grads(self):
+        self._retain_grads = True
+        return self
+
+    def register_hook(self, hook: Callable):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        """Run reverse-mode autograd from this tensor over the eager tape."""
+        backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            new = apply(lambda x, v: x.at[idx].set(v), self, value, name="setitem")
+        else:
+            new = apply(lambda x: x.at[idx].set(value), self, name="setitem")
+        self._adopt(new)
+
+    def _adopt(self, other: "Tensor"):
+        """In-place update: take over another tensor's value and tape link."""
+        self._data = other._data
+        self._node = other._node
+        self._out_idx = other._out_idx
+        if self._node is not None:
+            self._node.out_refs[self._out_idx] = weakref.ref(self)
+        self.stop_gradient = other.stop_gradient
+
+    # NOTE: arithmetic dunders and the broad method surface are attached by
+    # paddle_tpu.tensor (functional API) at import time to avoid circularity.
+
+
+class Parameter(Tensor):
+    """Trainable tensor (stop_gradient=False), with an optimizer trainable flag."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "spec")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.spec = None  # jax PartitionSpec for SPMD sharding
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Op dispatch
+# ---------------------------------------------------------------------------
+
+_amp_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def apply(fn: Callable, *args, name: str = "", **static_kw):
+    """Execute ``fn`` over raw arrays; record a VJP tape node if needed.
+
+    ``args`` may mix Tensors and array-likes/scalars; only float Tensor args
+    with ``stop_gradient=False`` are differentiated. ``static_kw`` are closed
+    over (never differentiated).
+    """
+    raw = [a._data if isinstance(a, Tensor) else a for a in args]
+    if _amp_hook is not None:
+        raw = _amp_hook(name, raw)
+
+    record = False
+    if is_grad_enabled():
+        for a in args:
+            if isinstance(a, Tensor) and not a.stop_gradient and not _is_tracer(a._data):
+                record = True
+                break
+
+    if not record:
+        out = fn(*raw, **static_kw) if static_kw else fn(*raw)
+        return _wrap_outputs(out, node=None)
+
+    diff_idx = [
+        i
+        for i, a in enumerate(args)
+        if isinstance(a, Tensor) and not a.stop_gradient and _is_floating(a._data)
+    ]
+    diff_tensors = [args[i] for i in diff_idx]
+
+    def fn_diff(*diff_vals):
+        vals = list(raw)
+        for i, v in zip(diff_idx, diff_vals):
+            vals[i] = v
+        return fn(*vals, **static_kw) if static_kw else fn(*vals)
+
+    primals, vjp_fn = jax.vjp(fn_diff, *(raw[i] for i in diff_idx))
+
+    flat = primals if isinstance(primals, (tuple, list)) else (primals,)
+    out_avals = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in flat]
+    node = TapeNode(vjp_fn, diff_tensors, out_avals, name=name)
+    result = _wrap_outputs(primals, node=node)
+
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(result, name)
+    return result
+
+
+def _wrap_outputs(out, node: Optional[TapeNode]):
+    multi = isinstance(out, (tuple, list))
+    flat = list(out) if multi else [out]
+    tensors = []
+    for i, arr in enumerate(flat):
+        sg = node is None or not _is_floating(arr)
+        t = Tensor(arr, stop_gradient=sg)
+        if node is not None:
+            t._node = node
+            t._out_idx = i
+            node.out_refs[i] = weakref.ref(t)
+        tensors.append(t)
+    if multi:
+        return tuple(tensors) if isinstance(out, tuple) else tensors
+    return tensors[0]
+
+
+def _check_nan_inf(result, name):
+    flat = result if isinstance(result, (tuple, list)) else [result]
+    for t in flat:
+        if _is_floating(t._data):
+            arr = np.asarray(t._data)
+            if not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op {name or '<anonymous>'}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Backward engine
+# ---------------------------------------------------------------------------
+
+def backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_graph: bool = False):
+    if root._node is None:
+        if not root.stop_gradient:
+            g = grad_tensor._data if grad_tensor is not None else jnp.ones_like(root._data)
+            _accumulate_leaf(root, g)
+        return
+
+    if grad_tensor is None:
+        if root.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad_tensor"
+            )
+        root_cot = jnp.ones_like(root._data)
+    else:
+        root_cot = grad_tensor._data
+
+    # Phase 1: discover reachable graph and count consumers per node
+    # (analogue of BasicEngine::PrepareDeps, basic_engine.cc:251).
+    dep_count = {}
+    visited = set()
+    stack = [root._node]
+    visited.add(root._node)
+    dep_count[root._node] = 0
+    while stack:
+        node = stack.pop()
+        for t in node.inputs:
+            prod = t._node
+            if prod is None:
+                continue
+            dep_count[prod] = dep_count.get(prod, 0) + 1
+            if prod not in visited:
+                visited.add(prod)
+                stack.append(prod)
+
+    # Phase 2: queue-driven execution with cotangent accumulation
+    # (analogue of BasicEngine::Execute, basic_engine.cc:379).
+    pending: dict = {root._node: {root._out_idx: root_cot}}
+    ready = [root._node]
+    while ready:
+        node = ready.pop()
+        cots_map = pending.pop(node, {})
+        cots = []
+        for i, aval in enumerate(node.out_avals):
+            c = cots_map.get(i)
+            if c is None:
+                c = jnp.zeros(aval.shape, aval.dtype)
+            out_ref = node.out_refs[i]
+            out_t = out_ref() if out_ref is not None else None
+            if out_t is not None:
+                for hook in out_t._hooks:
+                    res = hook(Tensor(c))
+                    if res is not None:
+                        c = res._data if isinstance(res, Tensor) else res
+                if out_t._retain_grads and out_t._node is not None:
+                    _accumulate_leaf(out_t, c)
+            cots.append(c)
+
+        in_cots = node.vjp_fn(tuple(cots) if len(cots) > 1 else cots[0])
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+        for t, g in zip(node.inputs, in_cots):
+            prod = t._node
+            if prod is None:
+                _accumulate_leaf(t, g)
+            else:
+                slot = pending.setdefault(prod, {})
+                if t._out_idx in slot:
+                    slot[t._out_idx] = slot[t._out_idx] + g
+                else:
+                    slot[t._out_idx] = g
+                dep_count[prod] -= 1
+                if dep_count[prod] == 0:
+                    ready.append(prod)
+
+
+def _accumulate_leaf(t: Tensor, g):
+    if t.stop_gradient:
+        return
+    for hook in t._hooks:
+        if t._node is None:  # leaf hooks fire on the final grad
+            res = hook(Tensor(g))
+            if res is not None:
+                g = res._data if isinstance(res, Tensor) else res
+    if t.grad is None:
+        t.grad = Tensor(g)
+    else:
+        t.grad = Tensor(t.grad._data + g)
+    t.grad.stop_gradient = True

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — mesh-based parallelism over XLA collectives.
+
+Reference surface: python/paddle/distributed/ (collective.py, fleet/,
+parallel.py, spawn.py, launch). Design mapping (see SURVEY.md §5/§7):
+ring_id→named mesh axes, c_allreduce→psum, send/recv→ppermute,
+meta-optimizer program rewrites→sharding specs + function transforms.
+"""
+
+from . import env  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+
+
+def __getattr__(name):
+    # lazy imports to avoid heavy costs / cycles at package import
+    if name in ("all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+                "alltoall", "send", "recv", "barrier", "new_group", "wait",
+                "ReduceOp", "split", "all_reduce_arrays"):
+        from . import collective
+        return getattr(collective, name)
+    if name == "fleet":
+        from . import fleet
+        return fleet
+    if name == "meta_parallel":
+        from . import meta_parallel
+        return meta_parallel
+    if name == "spawn":
+        from .spawn_mod import spawn
+        return spawn
+    if name == "launch":
+        from . import launch
+        return launch
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

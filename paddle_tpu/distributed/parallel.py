@@ -1,0 +1,117 @@
+"""init_parallel_env + DataParallel.
+
+Reference: python/paddle/distributed/parallel.py:69 (init_parallel_env boots
+NCCL+gloo per rank) and fluid/dygraph/parallel.py:389 (DataParallel wraps the
+model with a C++ Reducer doing bucketed grad allreduce).
+
+TPU-native: `init_parallel_env` calls jax.distributed.initialize (the
+coordination service replaces TCP NCCL-id exchange) and records the default
+device mesh. `DataParallel` needs NO reducer — inside a jitted step, grads of
+a data-sharded batch are averaged by a single psum that XLA schedules to
+overlap with the backward (the compiler replaces the Reducer's bucketing
+heuristics). Eagerly (single-host) it runs the layer unchanged and provides
+grad-allreduce hooks for multi-process parity tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..nn.layer import Layer
+from . import env
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel",
+           "ParallelEnv"]
+
+
+def init_parallel_env():
+    """Boot multi-process JAX if env vars are present; no-op single-process."""
+    if env.is_initialized():
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "12355")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=nprocs,
+            process_id=rank,
+        )
+    env.mark_initialized()
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return env.get_rank()
+
+
+def get_world_size() -> int:
+    return env.get_world_size()
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    @property
+    def world_size(self):
+        return env.get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    local_rank = rank
+    nranks = world_size
+
+
+class DataParallel(Layer):
+    """Wraps a layer for data-parallel training.
+
+    In the jitted path, `paddle_tpu.distributed.fleet.distributed_model`
+    shards the batch over the mesh 'dp' axis and XLA inserts the grad
+    all-reduce — this wrapper is then just identity + API parity
+    (`scale_loss`, `no_sync` kept as no-ops because XLA owns scheduling).
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Eager multi-process grad allreduce (parity test path)."""
+        from .collective import all_reduce_arrays
+        params = [p for p in self._layers.parameters() if p.grad is not None]
+        if not params or env.get_world_size() <= 1:
+            return
+        arrays = [p.grad._data for p in params]
+        reduced = all_reduce_arrays(arrays)
+        n = env.get_world_size()
+        for p, arr in zip(params, reduced):
+            p.grad._data = arr / n
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,40 @@
+"""Checkpoint save/load.
+
+Reference: python/paddle/framework/io.py:553 (save), :769 (load) — pickle of
+nested state_dicts with Tensor→numpy conversion. Kept byte-compatible in
+spirit (pickle of numpy arrays); the sharded/async checkpoint path for
+distributed training lives in paddle_tpu.distributed.checkpoint (orbax).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.data)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,206 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py —
+Model:906, fit:1556, DynamicGraphAdapter:666).
+
+TPU-native: `prepare()` builds a jitted TrainStep (forward+loss+grad+opt in
+one compiled program with donation) — the analogue of the reference's
+static-graph adapter, without a Program in sight. `fit` drives DataLoaders
+and callbacks around it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..io.dataloader import DataLoader
+from ..jit.to_static import TrainStep
+from ..metric import Metric
+from . import callbacks as cbks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        else:
+            self._metrics = []
+
+        if optimizer is not None and loss is not None:
+            loss_layer = loss
+
+            def loss_fn(net, *batch):
+                # convention: last element(s) are labels; single-label case
+                *xs, y = batch
+                out = net(*xs)
+                return loss_layer(out, y)
+
+            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+        return self
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        batch = list(inputs) + (list(labels) if labels else [])
+        self.network.train()
+        loss = self._train_step(*batch)
+        return [float(np.asarray(loss.data))]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        outputs = self.network(*inputs)
+        metrics = []
+        if labels is not None and self._loss is not None:
+            labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outputs, labels_l[0])
+            metrics.append(float(np.asarray(loss.data)))
+        for m in self._metrics:
+            if labels is not None:
+                labels_l = labels if isinstance(labels, (list, tuple)) else [labels]
+                corr = m.compute(outputs, labels_l[0])
+                m.update(corr)
+        return metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        out = self.network(*inputs)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o.data) for o in out]
+        return [np.asarray(out.data)]
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) else \
+                DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, verbose=verbose,
+            steps=len(train_loader) if hasattr(train_loader, "__len__") else None,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            metrics=["loss"] + [n for m in self._metrics
+                                for n in (m.name() if isinstance(m.name(), list)
+                                          else [m.name()])])
+
+        cbks.on_begin("train")
+        steps_done = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, {})
+                batch = batch if isinstance(batch, (tuple, list)) else [batch]
+                *xs, y = batch
+                losses = self.train_batch(xs, [y])
+                logs = {"loss": losses[0], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                steps_done += 1
+                if num_iters is not None and steps_done >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs if "logs" in dir() else {})
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0)
+            if self.stop_training or (num_iters is not None and steps_done >= num_iters):
+                break
+        cbks.on_end("train")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (tuple, list)) else [batch]
+            *xs, y = batch
+            out = self.eval_batch(xs, [y])
+            if out:
+                losses.append(out[0])
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                result[n] = v
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (tuple, list)) else [batch]
+            xs = batch[:-1] if len(batch) > 1 else batch
+            outputs.append(self.predict_batch(list(xs)))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        if self._train_step is not None:
+            # re-seed the compiled step's device state from the layer
+            self._train_step.__init__(self.network, self._train_step.loss_fn,
+                                      self._optimizer)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

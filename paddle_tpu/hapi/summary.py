@@ -1,0 +1,34 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for _, p in layer._parameters.items():
+            if p is None:
+                continue
+            n = int(np.prod(p.shape)) if p.shape else 1
+            n_params += n
+        if n_params or not layer._sub_layers:
+            rows.append((name or type(net).__name__, type(layer).__name__, n_params))
+    for _, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total_params += n
+        if getattr(p, "trainable", True):
+            trainable_params += n
+    lines = [f"{'Layer':<46}{'Type':<26}{'Params':>12}", "-" * 84]
+    for name, tname, n in rows:
+        lines.append(f"{name:<46}{tname:<26}{n:>12,}")
+    lines += ["-" * 84,
+              f"Total params: {total_params:,}",
+              f"Trainable params: {trainable_params:,}"]
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total_params, "trainable_params": trainable_params}

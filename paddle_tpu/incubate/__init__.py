@@ -1,0 +1,32 @@
+"""Incubating ops (reference: python/paddle/incubate/).
+
+softmax_mask_fuse* are plain jnp compositions — XLA fuses mask+softmax into
+surrounding matmuls on TPU, which is the entire point of the reference's
+hand-fused CUDA kernels (incubate/operators/softmax_mask_fuse_upper_triangle.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    import jax
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                 name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    import jax
+
+    def _fn(a):
+        S = a.shape[-1]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        masked = jnp.where(causal, a, -1e30)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply(_fn, x, name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,268 @@
+"""DataLoader.
+
+Reference: python/paddle/fluid/reader.py:146 (DataLoader),
+dataloader/dataloader_iter.py (single/multiprocess iters),
+operators/reader/buffered_reader.cc (device double-buffering).
+
+TPU redesign: worker processes produce numpy batches over a
+multiprocessing queue (shared-memory tensors in the reference become plain
+numpy + pickle here — the device copy is the real cost and is overlapped);
+the device prefetcher replaces BufferedReader with an async ``device_put``
+double buffer (XLA transfers are async; we just keep N batches in flight).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (structure-preserving)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.data) for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_init_fn,
+                 worker_id):
+    """reference: dataloader/worker.py:257 _worker_loop."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            out_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate like ExceptionHolder
+            out_queue.put((batch_id, None, e))
+
+
+class _MultiprocessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        self.index_queue = ctx.Queue()
+        self.out_queue = ctx.Queue()
+        self.workers = []
+        for wid in range(loader.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queue, self.out_queue,
+                      loader.collate_fn, loader.worker_init_fn, wid),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+        self.batch_iter = iter(loader.batch_sampler)
+        self.send_id = 0
+        self.recv_id = 0
+        self.reorder = {}
+        self.exhausted = False
+        # prime the pipeline
+        for _ in range(loader.num_workers * 2):
+            self._send_next()
+
+    def _send_next(self):
+        if self.exhausted:
+            return
+        try:
+            indices = next(self.batch_iter)
+        except StopIteration:
+            self.exhausted = True
+            return
+        self.index_queue.put((self.send_id, indices))
+        self.send_id += 1
+
+    def __next__(self):
+        if self.recv_id >= self.send_id and self.exhausted:
+            self._shutdown()
+            raise StopIteration
+        while self.recv_id not in self.reorder:
+            batch_id, data, err = self.out_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise err
+            self.reorder[batch_id] = data
+        data = self.reorder.pop(self.recv_id)
+        self.recv_id += 1
+        self._send_next()
+        return data
+
+    def _shutdown(self):
+        for _ in self.workers:
+            try:
+                self.index_queue.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1.0)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        self._shutdown()
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.batch_iter = iter(loader.batch_sampler)
+
+    def __next__(self):
+        indices = next(self.batch_iter)
+        samples = [self.loader.dataset[i] for i in indices]
+        return self.loader.collate_fn(samples)
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+
+    def __next__(self):
+        batch = list(itertools.islice(self.it, self.loader.batch_size))
+        if not batch or (self.loader.drop_last and
+                         len(batch) < self.loader.batch_size):
+            raise StopIteration
+        return self.loader.collate_fn(batch)
+
+
+class _DevicePrefetcher:
+    """Async device_put double-buffer (BufferedReader analogue)."""
+
+    def __init__(self, inner, places, to_tensor, depth=2):
+        self.inner = inner
+        self.places = places
+        self.to_tensor = to_tensor
+        self.depth = depth
+        self.buffer = []
+        self._fill()
+
+    def _convert(self, batch):
+        import jax
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                arr = jax.device_put(x, self.places)
+                return Tensor(arr) if self.to_tensor else arr
+            if isinstance(x, (tuple, list)):
+                return type(x)(conv(i) for i in x)
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+        return conv(batch)
+
+    def _fill(self):
+        while len(self.buffer) < self.depth:
+            try:
+                batch = next(self.inner)
+            except StopIteration:
+                break
+            self.buffer.append(self._convert(batch))
+
+    def __next__(self):
+        if not self.buffer:
+            raise StopIteration
+        out = self.buffer.pop(0)
+        self._fill()
+        return out
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """reference: fluid/reader.py DataLoader:146."""
+
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = prefetch_factor
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._is_iterable_ds = isinstance(dataset, IterableDataset)
+
+        if places is None:
+            import jax
+            places = jax.devices()[0]
+        elif hasattr(places, "jax_device"):
+            places = places.jax_device
+        elif isinstance(places, (list, tuple)) and places:
+            p0 = places[0]
+            places = p0.jax_device if hasattr(p0, "jax_device") else p0
+        self.places = places
+
+        if not self._is_iterable_ds:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __iter__(self):
+        if self._is_iterable_ds:
+            inner = _IterableDatasetIter(self)
+        elif self.num_workers > 0:
+            inner = _MultiprocessIter(self)
+        else:
+            inner = _SingleProcessIter(self)
+        if self.use_buffer_reader:
+            return _DevicePrefetcher(inner, self.places, self.return_list,
+                                     depth=self.prefetch_factor)
+
+        class _PlainIter:
+            def __init__(self, it):
+                self.it = it
+
+            def __next__(self):
+                batch = next(self.it)
+                def conv(x):
+                    if isinstance(x, np.ndarray):
+                        return Tensor(x)
+                    if isinstance(x, (tuple, list)):
+                        return type(x)(conv(i) for i in x)
+                    return x
+                return conv(batch)
+
+            def __iter__(self):
+                return self
+
+        return _PlainIter(inner)
+
+    def __len__(self):
+        if self._is_iterable_ds:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,11 @@
+"""paddle_tpu.jit — trace-and-compile (reference: python/paddle/jit/).
+
+`to_static` compiles a Layer (or function over Tensors) into cached XLA
+executables per input signature — the TPU-native analogue of the reference's
+ProgramTranslator, with tracing instead of AST rewriting. `save`/`load`
+export StableHLO in place of the reference's inference ProgramDesc.
+"""
+
+from .functional import bind, functional_call, param_arrays, unwrap, wrap  # noqa: F401
+from .to_static import StaticFunction, save, load, to_static, TrainStep  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401

@@ -1,0 +1,122 @@
+"""Functionalisation of Layers.
+
+The TPU-native replacement for the reference's dygraph→static translator
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:768):
+instead of AST-rewriting python into ProgramDesc, we *bind* a Layer's
+parameters/buffers to raw arrays (or tracers) for the duration of a call, so
+ordinary forward() code traces under jax.jit unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from ..core.random import trace_rng
+from ..core.tensor import Tensor, no_grad
+
+
+def named_params_and_buffers(layer) -> Tuple[Dict[str, Tensor], Dict[str, Tensor]]:
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    return params, buffers
+
+
+def param_arrays(layer) -> Dict[str, jax.Array]:
+    return {k: p._data for k, p in layer.named_parameters()}
+
+
+def trainable_param_arrays(layer) -> Dict[str, jax.Array]:
+    return {k: p._data for k, p in layer.named_parameters()
+            if getattr(p, "trainable", True) and not p.stop_gradient}
+
+
+def buffer_arrays(layer) -> Dict[str, jax.Array]:
+    return {k: b._data for k, b in layer.named_buffers()}
+
+
+@contextlib.contextmanager
+def bind(layer, params: Optional[Dict[str, Any]] = None,
+         buffers: Optional[Dict[str, Any]] = None):
+    """Temporarily swap parameter/buffer storage with the given arrays.
+
+    After the with-block, buffer entries in ``buffers`` are REFRESHED to the
+    final (possibly traced) values so callers can thread running-stat updates
+    through jit as pure state.
+    """
+    p_objs, b_objs = named_params_and_buffers(layer)
+    saved_p = {k: t._data for k, t in p_objs.items()}
+    saved_b = {k: t._data for k, t in b_objs.items()}
+    try:
+        if params:
+            for k, arr in params.items():
+                if k in p_objs:
+                    p_objs[k]._data = arr
+        if buffers:
+            for k, arr in buffers.items():
+                if k in b_objs:
+                    b_objs[k]._data = arr
+        yield
+        if buffers is not None:
+            for k, t in b_objs.items():
+                if k in buffers:
+                    buffers[k] = t._data
+    finally:
+        for k, t in p_objs.items():
+            t._data = saved_p[k]
+        for k, t in b_objs.items():
+            t._data = saved_b[k]
+
+
+def functional_call(layer, params: Dict[str, Any], *args, buffers=None,
+                    rng=None, training: Optional[bool] = None, **kwargs):
+    """Call layer.forward as a pure function of (params, buffers, rng, args).
+
+    Returns (outputs, new_buffers). ``args`` may be raw arrays or Tensors;
+    outputs are unwrapped to raw arrays (pytree).
+    """
+    wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    buf = dict(buffers) if buffers is not None else buffer_arrays(layer)
+    prev_training = layer.training
+    if training is not None:
+        layer.training = training
+        for sub in layer.sublayers():
+            sub.training = training
+    key = rng if rng is not None else jax.random.key(0)
+    try:
+        with bind(layer, params, buf), no_grad(), trace_rng(key):
+            out = layer(*wrapped, **kwargs)
+    finally:
+        if training is not None:
+            layer.training = prev_training
+            for sub in layer.sublayers():
+                sub.training = prev_training
+    return unwrap(out), buf
+
+
+def unwrap(out):
+    """Tensor pytree -> raw array pytree."""
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, tuple):
+        return tuple(unwrap(o) for o in out)
+    if isinstance(out, list):
+        return [unwrap(o) for o in out]
+    if isinstance(out, dict):
+        return {k: unwrap(v) for k, v in out.items()}
+    return out
+
+
+def wrap(out):
+    """Raw array pytree -> Tensor pytree."""
+    if isinstance(out, jax.Array):
+        return Tensor(out)
+    if isinstance(out, tuple):
+        return tuple(wrap(o) for o in out)
+    if isinstance(out, list):
+        return [wrap(o) for o in out]
+    if isinstance(out, dict):
+        return {k: wrap(v) for k, v in out.items()}
+    return out

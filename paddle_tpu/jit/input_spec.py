@@ -1,0 +1,15 @@
+"""InputSpec (reference: python/paddle/static/input.py InputSpec)."""
+
+from __future__ import annotations
+
+from ..core import dtypes
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

@@ -1,0 +1,321 @@
+"""to_static: compile Layers/functions to cached XLA executables.
+
+Design (vs reference program_translator.py:768):
+- Forward inference: one jitted pure function per input signature.
+- Eager-tape training through a StaticFunction: the whole compiled call
+  becomes ONE tape node; its backward re-runs the compiled VJP (forward
+  rematerialised inside the compiled backward — everything stays in XLA).
+- The real training hot path is :class:`TrainStep`, which compiles
+  forward+loss+grad+optimizer into a single donated-buffer executable
+  (the analogue of the reference's whole-Program execution).
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import make_rng, trace_rng
+from ..core.tensor import TapeNode, Tensor, is_grad_enabled, no_grad
+from ..nn.layer import Layer
+from .functional import (bind, buffer_arrays, param_arrays,
+                         trainable_param_arrays, unwrap, wrap)
+from .input_spec import InputSpec
+
+
+def _sig_of(arrays):
+    leaves, treedef = jax.tree_util.tree_flatten(arrays)
+    return (tuple((a.shape, str(a.dtype)) if hasattr(a, "shape") else (type(a), a)
+                  for a in leaves), treedef)
+
+
+class StaticFunction:
+    """Callable wrapping a Layer's forward (or a plain fn) with jit caching."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None,
+                 input_spec=None):
+        self._fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Callable] = {}
+        self._bwd_cache: Dict[Any, Callable] = {}
+        functools.update_wrapper(self, function)
+
+    # -- pure function factory ---------------------------------------------
+    def _pure(self, treedef, kwargs):
+        layer = self._layer
+        fn = self._fn
+        training = layer.training if layer is not None else False
+
+        def pure(p_arrays, b_arrays, key, flat_inputs):
+            inputs = jax.tree_util.tree_unflatten(treedef, flat_inputs)
+            tensors = [Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) or
+                       hasattr(a, "dtype") else a for a in inputs]
+            bufs = dict(b_arrays)
+            with trace_rng(key), no_grad():
+                if layer is not None:
+                    with bind(layer, p_arrays, bufs):
+                        out = fn(*tensors, **kwargs)
+                else:
+                    out = fn(*tensors, **kwargs)
+            return unwrap(out), bufs
+
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        p_arrays = param_arrays(layer) if layer is not None else {}
+        b_arrays = buffer_arrays(layer) if layer is not None else {}
+        raw_inputs = [a._data if isinstance(a, Tensor) else a for a in args]
+        flat_inputs, treedef = jax.tree_util.tree_flatten(raw_inputs)
+        key = make_rng("to_static")
+
+        sig = (_sig_of(flat_inputs)[0], treedef,
+               tuple(sorted(kwargs.items())) if kwargs else (),
+               layer.training if layer is not None else False)
+
+        jitted = self._cache.get(sig)
+        if jitted is None:
+            pure = self._pure(treedef, kwargs)
+            jitted = jax.jit(pure)
+            self._cache[sig] = jitted
+
+        needs_grad = False
+        if is_grad_enabled() and layer is not None:
+            needs_grad = any(not p.stop_gradient
+                             for p in layer.parameters())
+
+        if not needs_grad:
+            out_arrays, new_bufs = jitted(p_arrays, b_arrays, key, flat_inputs)
+            if layer is not None:
+                for k, b in layer.named_buffers():
+                    if k in new_bufs:
+                        b._data = new_bufs[k]
+            return wrap(out_arrays)
+
+        # training path: one fused tape node, compiled remat backward
+        t_params = {k: p for k, p in layer.named_parameters()
+                    if not p.stop_gradient}
+        t_arrays = {k: p._data for k, p in t_params.items()}
+        frozen = {k: v for k, v in p_arrays.items() if k not in t_arrays}
+
+        pure = self._pure(treedef, kwargs)
+
+        def fwd_only(t_a, flat_in):
+            out, bufs = pure({**frozen, **t_a}, b_arrays, key, flat_in)
+            return out, bufs
+
+        out_arrays, new_bufs = jitted(p_arrays, b_arrays, key, flat_inputs)
+
+        bwd = self._bwd_cache.get(sig)
+        if bwd is None:
+            def bwd_fn(t_a, flat_in, cotangents):
+                def f(t_a_inner, flat_inner):
+                    out, _ = fwd_only(t_a_inner, flat_inner)
+                    return out
+                _, vjp = jax.vjp(f, t_a, flat_in)
+                return vjp(cotangents)
+            bwd = jax.jit(bwd_fn)
+            self._bwd_cache[sig] = bwd
+
+        # tape node over (param tensors + diff input tensors)
+        diff_inputs = [a for a in args if isinstance(a, Tensor)
+                       and not a.stop_gradient]
+        node_inputs = list(t_params.values()) + diff_inputs
+
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out_arrays)
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+
+        captured_inputs = list(flat_inputs)
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if isinstance(cots, tuple) else [cots]
+            cot_tree = jax.tree_util.tree_unflatten(out_treedef, cot_list)
+            g_params, g_inputs = bwd(t_arrays, captured_inputs, cot_tree)
+            grads = [g_params[k] for k in t_params.keys()]
+            # map input grads back to diff tensor positions
+            flat_gin, _ = jax.tree_util.tree_flatten(g_inputs)
+            idx = 0
+            for a in args:
+                if isinstance(a, Tensor) and not a.stop_gradient:
+                    grads.append(flat_gin[idx])
+                if isinstance(a, Tensor):
+                    idx += 1
+            return tuple(grads)
+
+        node = TapeNode(vjp_fn, node_inputs, out_avals, name="to_static")
+        out_tensors = []
+        for i, arr in enumerate(out_leaves):
+            t = Tensor(arr, stop_gradient=not jnp.issubdtype(arr.dtype, jnp.floating))
+            if not t.stop_gradient:
+                t._node = node
+                t._out_idx = i
+                node.out_refs[i] = weakref.ref(t)
+            out_tensors.append(t)
+        if layer is not None:
+            for k, b in layer.named_buffers():
+                if k in new_bufs:
+                    b._data = new_bufs[k]
+        return jax.tree_util.tree_unflatten(out_treedef, out_tensors)
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self, *args):
+        return None  # parity shim
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling a Layer or function."""
+
+    def _decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(type(obj).forward.__get__(obj), layer=obj,
+                                    input_spec=input_spec)
+            obj.forward = static
+            return obj
+        # plain function or unbound Layer.forward
+        return StaticFunction(obj, layer=getattr(obj, "__self__", None),
+                              input_spec=input_spec)
+
+    if function is not None:
+        return _decorate(function)
+    return _decorate
+
+
+class TrainStep:
+    """Compile (model, loss, optimizer) into ONE donated XLA train step.
+
+    The TPU-native answer to the reference's static-graph training loop
+    (Program + Executor): params/opt-state live as device arrays owned by
+    this object; each step is a single compiled call with buffer donation.
+
+    `sync_to_layer()` writes values back into the Layer for checkpointing /
+    eager inspection.
+    """
+
+    def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
+                 metrics_fn: Optional[Callable] = None, donate: bool = True):
+        self.layer = layer
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.metrics_fn = metrics_fn
+        self.params = trainable_param_arrays(layer)
+        self.frozen = {k: v for k, v in param_arrays(layer).items()
+                       if k not in self.params}
+        self.buffers = buffer_arrays(layer)
+        self.opt_state = optimizer.init_state(self.params)
+        self.step_count = 0
+        self._jitted: Dict[Any, Callable] = {}
+        self._donate = donate
+
+    def _make_step(self, treedef, training=True):
+        layer, loss_fn, optimizer = self.layer, self.loss_fn, self.optimizer
+        frozen = self.frozen
+
+        def step(params, buffers, opt_state, lr, t, key, flat_batch):
+            batch = jax.tree_util.tree_unflatten(treedef, flat_batch)
+
+            def compute_loss(p):
+                tensors = [Tensor(b) for b in batch]
+                bufs = dict(buffers)
+                with trace_rng(key), no_grad():
+                    with bind(layer, {**frozen, **p}, bufs):
+                        loss = loss_fn(layer, *tensors)
+                loss_arr = loss._data if isinstance(loss, Tensor) else loss
+                return loss_arr.astype(jnp.float32), bufs
+
+            (loss, new_bufs), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state, lr, t)
+            return new_params, new_bufs, new_opt, loss
+
+        return step
+
+    def __call__(self, *batch):
+        raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        flat, treedef = jax.tree_util.tree_flatten(raw)
+        sig = (_sig_of(flat)[0], treedef)
+        jitted = self._jitted.get(sig)
+        if jitted is None:
+            fn = self._make_step(treedef)
+            donate = (0, 2) if self._donate else ()
+            jitted = jax.jit(fn, donate_argnums=donate)
+            self._jitted[sig] = jitted
+        self.step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.step_count, jnp.int32)
+        key = make_rng("train_step")
+        self.params, self.buffers, self.opt_state, loss = jitted(
+            self.params, self.buffers, self.opt_state, lr, t, key, flat)
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        merged = {**self.frozen, **self.params}
+        for k, p in self.layer.named_parameters():
+            if k in merged:
+                p._data = merged[k]
+        for k, b in self.layer.named_buffers():
+            if k in self.buffers:
+                b._data = self.buffers[k]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export: StableHLO text + params pickle (replaces save_inference_model).
+
+    reference: python/paddle/fluid/dygraph/jit.py save / io.py:1246.
+    """
+    import os
+    import pickle
+
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    params = param_arrays(layer)
+    buffers = buffer_arrays(layer)
+    meta = {"class": type(layer).__name__}
+
+    if input_spec:
+        specs = [s if isinstance(s, InputSpec) else InputSpec(s) for s in input_spec]
+        example = [jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
+                             s.dtype) for s in specs]
+
+        def pure(p, b, *inputs):
+            tensors = [Tensor(i) for i in inputs]
+            with bind(layer, p, dict(b)), no_grad(), trace_rng(jax.random.key(0)):
+                out = layer(*tensors)
+            return unwrap(out)
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            lowered = jax.jit(pure).lower(params, buffers, *example)
+            stablehlo = lowered.as_text(dialect="stablehlo")
+        finally:
+            if was_training:
+                layer.train()
+        with open(path + ".mlir", "w") as f:
+            f.write(stablehlo)
+        meta["input_spec"] = [(tuple(s.shape), str(np.dtype(s.dtype))) for s in specs]
+
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in {**params, **buffers}.items()}, f)
+    with open(path + ".pdmodel.meta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    """Load params saved by jit.save into a dict (model class must be
+    reconstructed by the caller; full TranslatedLayer support via the
+    inference module)."""
+    import pickle
+    with open(path + ".pdiparams", "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,24 @@
+"""paddle_tpu.nn — layer library (reference surface: python/paddle/nn/)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+
+from .layers.activation import *  # noqa: F401,F403
+from .layers.common import *  # noqa: F401,F403
+from .layers.conv import *  # noqa: F401,F403
+from .layers.loss import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+from .layers.rnn import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403
+
+from ..core.tensor import Parameter  # noqa: F401
+
+
+def __getattr__(name):
+    if name in ("ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"):
+        from ..optimizer import clip
+        return getattr(clip, name)
+    raise AttributeError(f"module 'paddle_tpu.nn' has no attribute {name!r}")

@@ -1,0 +1,202 @@
+"""Parameter initializers (reference: python/paddle/fluid/initializer.py,
+python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from
+the global RNG (core/random.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.random import make_rng
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle layout [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtypes.convert_dtype(dtype) or dtypes.get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return jax.random.normal(make_rng(), tuple(shape), d) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return (jax.random.truncated_normal(make_rng(), -2.0, 2.0, tuple(shape), d)
+                * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return jax.random.uniform(make_rng(), tuple(shape), d, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0, fan_in=None, fan_out=None):
+        self.gain, self.fan_in, self.fan_out = gain, fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(make_rng(), tuple(shape), d) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0, fan_in=None, fan_out=None):
+        self.gain, self.fan_in, self.fan_out = gain, fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(make_rng(), tuple(shape), d, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.slope)
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(make_rng(), tuple(shape), d) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(make_rng(), tuple(shape), d, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        arr = jnp.asarray(np.asarray(self.value), d)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"Assign shape {arr.shape} != param shape {tuple(shape)}")
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        return jax.nn.initializers.orthogonal(self.gain)(make_rng(), tuple(shape), d)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+        out = np.zeros(tuple(shape), np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(oc // self.groups, ic)):
+                idx = (g * (oc // self.groups) + i, i, *centers)
+                out[idx] = 1.0
+        return jnp.asarray(out, d)
+
+
+# paddle.ParamAttr analogue ---------------------------------------------------
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def _resolve_attr(attr, default_init):
+    """Normalise a param_attr/bias_attr argument to (initializer, trainable, name)."""
+    if attr is False:
+        return None
+    if attr is None:
+        return (default_init, True, None)
+    if isinstance(attr, ParamAttr):
+        return (attr.initializer or default_init, attr.trainable, attr.name)
+    if isinstance(attr, Initializer):
+        return (attr, True, None)
+    if isinstance(attr, str):
+        return (default_init, True, attr)
+    raise TypeError(f"Unsupported param attr: {attr!r}")

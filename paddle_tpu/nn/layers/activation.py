@@ -1,0 +1,95 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from ..layer import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "GELU",
+    "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh", "Hardshrink",
+    "Softshrink", "Tanhshrink", "Swish", "Silu", "Mish", "Softplus",
+    "Softsign", "Tanh", "LogSigmoid", "Softmax", "LogSoftmax", "Maxout",
+    "ThresholdedReLU", "RReLU", "GLU",
+]
+
+
+def _simple(fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            kw = dict(defaults)
+            names = list(defaults.keys())
+            for i, a in enumerate(args):
+                kw[names[i]] = a
+            kw.update({k: v for k, v in kwargs.items() if k in kw})
+            self._kw = kw
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Softsign = _simple("softsign")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+LogSigmoid = _simple("log_sigmoid")
+Tanhshrink = _simple("tanhshrink")
+Hardswish = _simple("hardswish")
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+ELU = _simple("elu", alpha=1.0)
+CELU = _simple("celu", alpha=1.0)
+SELU = _simple("selu")
+GELU = _simple("gelu", approximate=False)
+Hardsigmoid = _simple("hardsigmoid")
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Softshrink = _simple("softshrink", threshold=0.5)
+Softplus = _simple("softplus", beta=1.0, threshold=20.0)
+Softmax = _simple("softmax", axis=-1)
+LogSoftmax = _simple("log_softmax", axis=-1)
+Maxout = _simple("maxout", groups=2, axis=1)
+GLU = _simple("glu", axis=-1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        from ...core.tensor import apply
+        import jax.numpy as jnp
+        return apply(lambda a: jnp.where(a > self.threshold, a, 0.0), x,
+                     name="thresholded_relu")
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

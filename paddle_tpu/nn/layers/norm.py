@@ -1,0 +1,261 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..initializer import Constant
+from ..layer import Layer
+
+__all__ = [
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+    "InstanceNorm3D", "LocalResponseNorm", "SpectralNorm", "RMSNorm",
+]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL", name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCW" if data_format == "NCL" else "NLC")
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW", name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batchnorm.
+
+    Reference: nn/layer/norm.py SyncBatchNorm (sync_batch_norm CUDA op).
+    TPU-native: inside a pjit/shard_map step the mean/var reduction rides a
+    psum over the data axis; eagerly on one host it degrades to BatchNorm.
+    """
+
+    def forward(self, x):
+        from ...distributed import env as dist_env
+        axis = dist_env.current_data_axis()
+        if axis is None:
+            return super().forward(x)
+        from ...core.tensor import apply
+        import jax
+
+        def _sync_bn(a, rm, rv, w, b):
+            red = tuple(i for i in range(a.ndim) if i != 1)
+            local_mean = jnp.mean(a.astype(jnp.float32), axis=red)
+            local_sq = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=red)
+            mean = jax.lax.pmean(local_mean, axis)
+            sq = jax.lax.pmean(local_sq, axis)
+            var = sq - jnp.square(mean)
+            shape = [1] * a.ndim
+            shape[1] = a.shape[1]
+            out = (a - mean.reshape(shape).astype(a.dtype)) * \
+                jax.lax.rsqrt(var.reshape(shape) + self._epsilon).astype(a.dtype)
+            return out * w.reshape(shape) + b.reshape(shape)
+
+        if not self.training:
+            return super().forward(x)
+        return apply(_sync_bn, x, self._mean, self._variance, self.weight,
+                     self.bias, name="sync_batch_norm")
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively convert BatchNorm layers to SyncBatchNorm."""
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum, layer._epsilon,
+                                data_format=layer._data_format)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in list(layer._sub_layers.items()):
+            out._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm — beyond the reference's surface; standard for
+    modern LLM blocks and cheap on the VPU."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=weight_attr,
+            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        from ...core.tensor import apply
+        import jax
+        eps = self._epsilon
+        n = len(self._normalized_shape)
+
+        def _rms(a, w):
+            axes = tuple(range(a.ndim - n, a.ndim))
+            ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
+            return (a * jax.lax.rsqrt(ms + eps).astype(a.dtype)) * w
+
+        return apply(_rms, x, self.weight, name="rms_norm")
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_channels,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight, self.bias)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False or bias_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+            self.bias = self.create_parameter(
+                (num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization via power iteration
+    (reference: nn/layer/norm.py SpectralNorm / spectral_norm op)."""
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[axis]
+        w = int(np.prod(weight_shape)) // h
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.tensor import apply
+        axis, iters, eps = self._axis, self._power_iters, self._epsilon
+
+        def _sn(w, u, v):
+            wm = jnp.moveaxis(w, axis, 0)
+            mat = wm.reshape(wm.shape[0], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        return apply(_sn, weight, self.weight_u, self.weight_v, name="spectral_norm")

@@ -1,0 +1,327 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py — cudnn LSTM/GRU).
+
+TPU-native design: cells are jnp compositions; the sequence loop uses
+jax.lax.scan inside a single traced op so XLA compiles one fused loop instead
+of per-step dispatch (the cudnn-RNN analogue).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .. import functional as F
+from ..initializer import Uniform
+from ..layer import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        states_shapes = self.state_shape
+        if isinstance(states_shapes, (list, tuple)) and \
+                isinstance(states_shapes[0], (list, tuple)):
+            return tuple(full((B,) + tuple(s), init_value, dtype)
+                         for s in states_shapes)
+        return full((B,) + tuple(states_shapes), init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _cell(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        h = apply(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _cell(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply(_cell, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, name="lstm_cell")
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over a sequence via lax.scan (single fused XLA loop)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_ref = inputs
+            idx = 0 if self.time_major else 0
+            B = inputs.shape[1] if self.time_major else inputs.shape[0]
+            from ...tensor.creation import zeros
+            ss = self.cell.state_shape
+            if isinstance(ss[0], (tuple, list)):
+                initial_states = tuple(zeros((B,) + tuple(s)) for s in ss)
+            else:
+                initial_states = zeros((B,) + tuple(ss))
+
+        cell = self.cell
+        time_major = self.time_major
+        is_reverse = self.is_reverse
+        is_lstm = isinstance(cell, LSTMCell)
+        is_gru = isinstance(cell, GRUCell)
+
+        params = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+        states_list = list(initial_states) if isinstance(initial_states, (tuple, list)) \
+            else [initial_states]
+
+        def _rnn(x, *arrs):
+            n_states = len(states_list)
+            states0 = arrs[:n_states]
+            wi, wh, bi, bh = arrs[n_states:]
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)
+            if is_reverse:
+                seq = jnp.flip(seq, 0)
+
+            def step(carry, xt):
+                if is_lstm:
+                    h, c = carry
+                    gates = xt @ wi.T + bi + h @ wh.T + bh
+                    i, f, g, o = jnp.split(gates, 4, axis=-1)
+                    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                    g = jnp.tanh(g)
+                    new_c = f * c + i * g
+                    new_h = o * jnp.tanh(new_c)
+                    return (new_h, new_c), new_h
+                if is_gru:
+                    h = carry[0]
+                    gi = xt @ wi.T + bi
+                    gh = h @ wh.T + bh
+                    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                    r = jax.nn.sigmoid(ir + hr)
+                    z = jax.nn.sigmoid(iz + hz)
+                    cand = jnp.tanh(ic + r * hc)
+                    new_h = (1 - z) * cand + z * h
+                    return (new_h,), new_h
+                h = carry[0]
+                new_h = jnp.tanh(xt @ wi.T + bi + h @ wh.T + bh)
+                return (new_h,), new_h
+
+            final, outs = jax.lax.scan(step, tuple(states0), seq)
+            if is_reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + tuple(final)
+
+        results = apply(_rnn, inputs, *states_list, *params, name="rnn_scan")
+        outputs = results[0]
+        final_states = results[1:]
+        if is_lstm:
+            return outputs, tuple(final_states)
+        return outputs, final_states[0]
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw = states_bw = None
+        if initial_states is not None:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.hidden_size = hidden_size
+        bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.num_directions = bidirect
+
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell, "RNN_TANH": SimpleRNNCell}[mode]
+        kwargs = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * bidirect
+            if bidirect == 2:
+                self.rnns.append(BiRNN(cell_cls(in_size, hidden_size, **kwargs),
+                                       cell_cls(in_size, hidden_size, **kwargs),
+                                       time_major=time_major))
+            else:
+                self.rnns.append(RNN(cell_cls(in_size, hidden_size, **kwargs),
+                                     time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_h, final_c = [], []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out, None)
+            if i < self.num_layers - 1 and self.dropout > 0:
+                out = F.dropout(out, self.dropout, training=self.training)
+            if self.mode == "LSTM":
+                if self.num_directions == 2:
+                    (h_fw, c_fw), (h_bw, c_bw) = st
+                    final_h += [h_fw, h_bw]
+                    final_c += [c_fw, c_bw]
+                else:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+            else:
+                if self.num_directions == 2:
+                    final_h += [st[0], st[1]]
+                else:
+                    final_h.append(st)
+        from ...tensor.manipulation import stack
+        if self.mode == "LSTM":
+            return out, (stack(final_h, 0), stack(final_c, 0))
+        return out, stack(final_h, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

@@ -1,0 +1,10 @@
+"""Hand-optimised TPU ops: Pallas kernels + fused XLA compositions.
+
+This package replaces the reference's `operators/fused/` CUDA kernels
+(fused_attention_op.cu, fused_feedforward_op.cu, fused_dropout_helper.h):
+on TPU, XLA fuses most epilogues automatically, so only genuinely
+fusion-resistant patterns (flash attention tiling, ring attention
+communication overlap) get Pallas kernels.
+"""
+
+from . import attention  # noqa: F401

@@ -1,0 +1,83 @@
+"""Attention ops: XLA composition + Pallas flash-attention dispatch.
+
+Replaces the reference's fused attention stack
+(reference: paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h)
+with a TPU design: a flash-attention Pallas kernel for the hot path and an
+XLA softmax composition fallback (XLA already fuses scale+mask+softmax into
+the surrounding matmuls well).
+Layout convention: [batch, seq, heads, head_dim] (paddle MultiHeadAttention
+uses [B, S, H*D] outside, [B, H, S, D] inside scores).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import make_rng
+from ..core.tensor import Tensor, apply
+
+
+def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
+    """Reference composition: works on [B, S, H, D]."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if is_causal:
+        causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_supported(q, k, v, mask, dropout_p) -> bool:
+    if dropout_p > 0.0 or mask is not None:
+        return False
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    return (
+        jax.default_backend() == "tpu"
+        and S % 128 == 0 and Sk % 128 == 0
+        and D in (64, 128, 256)
+        and S >= 256
+    )
+
+
+def sdpa_array(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
+               dropout_key=None, use_flash=True):
+    """Raw-array scaled dot-product attention with flash dispatch."""
+    if use_flash and _flash_supported(q, k, v, mask, dropout_p):
+        from .pallas.flash_attention import flash_attention
+        try:
+            return flash_attention(q, k, v, causal=is_causal)
+        except Exception:
+            pass
+    return _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key)
+
+
+def scaled_dot_product_attention(query: Tensor, key: Tensor, value: Tensor,
+                                 attn_mask: Optional[Tensor] = None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True) -> Tensor:
+    dk = make_rng() if (dropout_p > 0.0 and training) else None
+    p = dropout_p if training else 0.0
+
+    def _fn(q, k, v, *maybe_mask):
+        m = maybe_mask[0] if maybe_mask else None
+        return sdpa_array(q, k, v, m, p, is_causal, dk)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply(_fn, *args, name="scaled_dot_product_attention")

@@ -1,0 +1,15 @@
+"""Pallas flash attention (TPU).
+
+Tiled online-softmax attention over VMEM blocks; replaces the reference's
+fmha CUDA kernels (reference: operators/fused/fused_attention_op.cu).
+Custom VJP so the eager tape and jit grads both work.
+
+This file currently exposes the API; the tuned kernel lands with the model
+milestone — callers fall back to the XLA composition via ops.attention.
+"""
+
+from __future__ import annotations
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
+    raise NotImplementedError("pallas flash attention kernel pending")

@@ -1,0 +1,7 @@
+"""paddle_tpu.optimizer (reference surface: python/paddle/optimizer/)."""
+
+from . import lr  # noqa: F401
+from .adam import Adam, Adadelta, Adagrad, Adamax, AdamW, Lamb, RMSProp  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
+from .sgd import SGD, Momentum  # noqa: F401

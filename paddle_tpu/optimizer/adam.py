@@ -1,0 +1,200 @@
+"""Adam-family optimizers (reference: operators/optimizers/adam_op.cc,
+python/paddle/optimizer/adam.py, adamw.py, lamb.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Lamb", "Adamax", "Adadelta", "Adagrad", "RMSProp"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def _init_slot(self, param):
+        m = jnp.zeros(param.shape, jnp.float32)
+        v = jnp.zeros(param.shape, jnp.float32)
+        return (m, v)
+
+    def _update(self, param, grad, slots, lr, t):
+        m, v = slots
+        g = grad.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        t_f = jnp.asarray(t, jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, t_f)
+        bc2 = 1 - jnp.power(self.beta2, t_f)
+        lr_t = lr * jnp.sqrt(bc2) / bc1
+        new_param = param.astype(jnp.float32) - lr_t * m / (jnp.sqrt(v) + self.epsilon)
+        return new_param, (m, v)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else getattr(weight_decay, "coeff", 0.0)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, param, grad, slots, lr, t):
+        new_param, new_slots = super()._update(param, grad, slots, lr, t)
+        if self._wd_coeff:
+            new_param = new_param - lr * self._wd_coeff * param.astype(jnp.float32)
+        return new_param, new_slots
+
+    def apply_gradients(self, params, grads, state, lr=None, step=None):
+        """Respect apply_decay_param_fun by name (paddle semantics)."""
+        if self._apply_decay_param_fun is None:
+            return super().apply_gradients(params, grads, state, lr, step)
+        saved = self._wd_coeff
+        new_params, new_state = {}, {}
+        if lr is None:
+            lr = self.get_lr()
+        if step is None:
+            step = self._step_count + 1
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k], new_state[k] = p, state[k]
+                continue
+            self._wd_coeff = saved if self._apply_decay_param_fun(k) else 0.0
+            np_, ns = self._update(p, g, state[k], lr, step)
+            new_params[k] = np_.astype(p.dtype)
+            new_state[k] = ns
+        self._wd_coeff = saved
+        return new_params, new_state
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.cc."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.wd = lamb_weight_decay
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, param):
+        return (jnp.zeros(param.shape, jnp.float32),
+                jnp.zeros(param.shape, jnp.float32))
+
+    def _update(self, param, grad, slots, lr, t):
+        m, v = slots
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        t_f = jnp.asarray(t, jnp.float32)
+        m_hat = m / (1 - jnp.power(self.beta1, t_f))
+        v_hat = v / (1 - jnp.power(self.beta2, t_f))
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + self.wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p32 - lr * trust * r, (m, v)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, param):
+        return (jnp.zeros(param.shape, jnp.float32),
+                jnp.zeros(param.shape, jnp.float32))
+
+    def _update(self, param, grad, slots, lr, t):
+        m, u = slots
+        g = grad.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        t_f = jnp.asarray(t, jnp.float32)
+        lr_t = lr / (1 - jnp.power(self.beta1, t_f))
+        return param.astype(jnp.float32) - lr_t * m / (u + self.epsilon), (m, u)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _init_slot(self, param):
+        return (jnp.zeros(param.shape, jnp.float32),
+                jnp.zeros(param.shape, jnp.float32))
+
+    def _update(self, param, grad, slots, lr, t):
+        avg_sq, avg_upd = slots
+        g = grad.astype(jnp.float32)
+        avg_sq = self.rho * avg_sq + (1 - self.rho) * jnp.square(g)
+        upd = jnp.sqrt(avg_upd + self.epsilon) / jnp.sqrt(avg_sq + self.epsilon) * g
+        avg_upd = self.rho * avg_upd + (1 - self.rho) * jnp.square(upd)
+        return param.astype(jnp.float32) - lr * upd, (avg_sq, avg_upd)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.epsilon = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def _init_slot(self, param):
+        return (jnp.full(param.shape, self.init_acc, jnp.float32),)
+
+    def _update(self, param, grad, slots, lr, t):
+        (acc,) = slots
+        g = grad.astype(jnp.float32)
+        acc = acc + jnp.square(g)
+        return param.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self.epsilon), (acc,)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _init_slot(self, param):
+        ms = jnp.zeros(param.shape, jnp.float32)
+        mom = jnp.zeros(param.shape, jnp.float32)
+        mg = jnp.zeros(param.shape, jnp.float32)
+        return (ms, mom, mg)
+
+    def _update(self, param, grad, slots, lr, t):
+        ms, mom, mg = slots
+        g = grad.astype(jnp.float32)
+        ms = self.rho * ms + (1 - self.rho) * jnp.square(g)
+        if self.centered:
+            mg = self.rho * mg + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * mom + lr * g / denom
+        return param.astype(jnp.float32) - mom, (ms, mom, mg)

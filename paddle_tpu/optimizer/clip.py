@@ -1,0 +1,60 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByGlobalNorm/Norm/Value).
+
+Clips operate on grad pytrees (dicts of arrays) so they compose with both the
+eager step() path and jitted functional updates; hybrid-parallel variants
+psum the global norm across model-parallel axes (see distributed)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class GradClipBase:
+    def __call__(self, grads: dict) -> dict:
+        raise NotImplementedError
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, grads):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class ClipGradByNorm(GradClipBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def _clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return {k: _clip(g) for k, g in grads.items()}
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    """Global L2 norm clip across all grads (the hybrid-parallel optimizer
+    wraps this to psum the squared norm over tp/pp groups — reference:
+    fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        # optional hook: called with the local squared-norm, returns global
+        self.norm_reduce_fn = None
+
+    def __call__(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads.values())
+        if self.norm_reduce_fn is not None:
+            sq = self.norm_reduce_fn(sq)
+        gnorm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return {k: (g * scale).astype(g.dtype) for k, g in grads.items()}

@@ -1,0 +1,50 @@
+"""SGD / Momentum (reference: operators/optimizers/sgd_op.cc, momentum_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _init_slot(self, param):
+        return ()
+
+    def _update(self, param, grad, slots, lr, t):
+        return param.astype(jnp.float32) - lr * grad.astype(jnp.float32), ()
+
+
+class Momentum(Optimizer):
+    """Heavy-ball / Nesterov momentum, with optional LARS-style local scaling
+    handled by Lars* subclasses in the reference; use_nesterov matches the
+    reference flag (reference: python/paddle/optimizer/momentum.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+        self.rescale_grad = rescale_grad
+
+    def _init_slot(self, param):
+        return (jnp.zeros(param.shape, jnp.float32),)
+
+    def _update(self, param, grad, slots, lr, t):
+        (vel,) = slots
+        g = grad.astype(jnp.float32) * self.rescale_grad
+        vel = self.momentum * vel + g
+        if self.use_nesterov:
+            delta = g + self.momentum * vel
+        else:
+            delta = vel
+        return param.astype(jnp.float32) - lr * delta, (vel,)

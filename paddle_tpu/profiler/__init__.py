@@ -1,0 +1,110 @@
+"""Profiler.
+
+Reference: paddle/fluid/platform/profiler.h (host RecordEvent) +
+device_tracer.cc (CUPTI timeline) + python fluid/profiler.py.
+
+TPU answer: wrap jax.profiler (XPlane traces viewable in TensorBoard /
+Perfetto) and keep a lightweight host-side event aggregation for op tables.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "profiler", "start_profiler",
+           "stop_profiler", "summary"]
+
+_tls = threading.local()
+_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_sec]
+_active = [False]
+
+
+class RecordEvent:
+    """Host-side RAII event marker (platform/profiler.h RecordEvent analogue);
+    also emits a jax.profiler.TraceAnnotation so events appear on xplane."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if _active[0]:
+            rec = _events[self.name]
+            rec[0] += 1
+            rec[1] += time.perf_counter() - self.t0
+        return False
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir=None):
+    _active[0] = True
+    _events.clear()
+    if log_dir:
+        jax.profiler.start_trace(log_dir)
+        _tls.trace_dir = log_dir
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    _active[0] = False
+    if getattr(_tls, "trace_dir", None):
+        jax.profiler.stop_trace()
+        _tls.trace_dir = None
+
+
+def summary(sorted_by="total"):
+    rows = sorted(_events.items(), key=lambda kv: -kv[1][1])
+    lines = [f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}"]
+    for name, (count, total) in rows:
+        lines.append(f"{name:<40} {count:>8} {total * 1e3:>12.3f} "
+                     f"{total * 1e3 / max(count, 1):>12.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", tracer_option="Default", log_dir=None,
+             sorted_key="total"):
+    """fluid.profiler.profiler context analogue."""
+    start_profiler(state, tracer_option, log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler()
+        print(summary(sorted_key))
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 log_dir="./profiler_log"):
+        self.log_dir = log_dir
+
+    def start(self):
+        jax.profiler.start_trace(self.log_dir)
+
+    def stop(self):
+        jax.profiler.stop_trace()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,124 @@
+"""paddle_tpu.static — static-graph compatibility facade.
+
+The reference's static mode (ProgramDesc + Executor, reference:
+python/paddle/fluid/framework.py Program:4392, executor.py:1065) maps onto
+jit tracing here: a "Program" is a traced pure function; the "Executor" jit
+compiles and runs it. This module offers the paddle.static surface for
+users migrating static-graph code; new code should use paddle_tpu.jit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+from ..jit.input_spec import InputSpec
+
+_static_mode = [False]
+
+
+def _enable_static_mode():
+    _static_mode[0] = True
+
+
+def _in_static_mode():
+    return _static_mode[0]
+
+
+class Program:
+    """A recorded pure function over named inputs (ProgramDesc analogue)."""
+
+    def __init__(self):
+        self._build_fn = None  # set by program_guard recording
+        self._inputs: Dict[str, InputSpec] = {}
+        self._fetch: List = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m = _default_main[0]
+    prev_s = _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0] = prev_m
+        _default_startup[0] = prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference: fluid/data.py). In eager-first mode
+    this returns a zero placeholder Tensor tagged with its name."""
+    shape = tuple(1 if (d is None or d < 0) else d for d in shape)
+    t = Tensor(np.zeros(shape, np.dtype(dtypes.convert_dtype(dtype))))
+    t.name = name
+    return t
+
+
+class Executor:
+    """Compatibility Executor: runs a python callable as the 'program'.
+
+    For real static-style training use paddle_tpu.jit.TrainStep — this class
+    exists so `exe.run(feed=..., fetch_list=...)` code keeps a familiar shape.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        if callable(program):
+            out = program(**(feed or {}))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            if return_numpy:
+                return [np.asarray(o.data) if isinstance(o, Tensor) else np.asarray(o)
+                        for o in outs]
+            return list(outs)
+        raise TypeError(
+            "paddle_tpu.static.Executor runs python callables; build models "
+            "eagerly and use jit.TrainStep for compiled training.")
+
+
+# nn facade for static-style layer helpers
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..nn import Linear
+        from ..nn import functional as F
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = Linear(in_dim, size)
+        from ..tensor.manipulation import reshape
+        flat = reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_dim,))
+        out = layer(flat)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+
+nn = _StaticNN()

@@ -1,0 +1,116 @@
+"""Functional tensor API + Tensor method attachment.
+
+Mirrors the reference's pattern of binding the `paddle.tensor.*` functional
+surface onto the Tensor class as methods
+(reference: python/paddle/tensor/__init__.py + fluid monkey-patching in
+python/paddle/fluid/dygraph/math_op_patch.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+
+# ---------------------------------------------------------------------------
+# Method attachment
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [math, manipulation, logic, search, linalg, stat, creation, random]
+
+_SKIP = {
+    "to_tensor", "zeros", "ones", "full", "arange", "linspace", "eye", "empty",
+    "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "standard_normal", "broadcast_shape", "is_tensor", "scatter_nd",
+}
+
+
+def _attach_methods():
+    for mod in _METHOD_SOURCES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(Tensor, name, fn)
+
+
+_attach_methods()
+
+# Paddle aliases with trailing-underscore in-place-ish semantics
+Tensor.transpose = manipulation.transpose
+Tensor.reshape_ = manipulation.reshape
+Tensor.scale = math.scale
+Tensor.uniform_ = random.uniform_
+Tensor.normal_ = random.normal_
+Tensor.exponential_ = random.exponential_
+
+
+def _inplace(name, fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._adopt(out)
+        return self
+    method.__name__ = name
+    setattr(Tensor, name, method)
+
+
+for _n, _f in [
+    ("add_", math.add), ("subtract_", math.subtract), ("multiply_", math.multiply),
+    ("scale_", math.scale), ("clip_", math.clip), ("ceil_", math.ceil),
+    ("floor_", math.floor), ("exp_", math.exp), ("sqrt_", math.sqrt),
+    ("rsqrt_", math.rsqrt), ("reciprocal_", math.reciprocal), ("round_", math.round),
+    ("abs_", math.abs), ("tanh_", math.tanh), ("square_", math.square),
+    ("zero_", lambda self: creation.zeros_like(self)),
+    ("fill_", lambda self, v: creation.full_like(self, v)),
+]:
+    _inplace(_n, _f)
+
+
+# -- arithmetic dunders -----------------------------------------------------
+
+def _rbin(fn):
+    def method(self, other):
+        return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+    return method
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = math.add
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _rbin(math.subtract)
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = math.multiply
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _rbin(math.divide)
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _rbin(math.floor_divide)
+Tensor.__mod__ = math.remainder
+Tensor.__rmod__ = _rbin(math.remainder)
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _rbin(math.pow)
+Tensor.__matmul__ = math.matmul
+Tensor.__rmatmul__ = _rbin(math.matmul)
+Tensor.__neg__ = math.neg
+Tensor.__abs__ = math.abs
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__and__ = logic.logical_and
+Tensor.__or__ = logic.logical_or
+Tensor.__xor__ = logic.logical_xor
+Tensor.__invert__ = logic.logical_not

@@ -1,0 +1,157 @@
+"""Tensor creation ops (reference surface: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.random import make_rng
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "arange", "linspace", "eye", "empty", "empty_like",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "numel", "complex", "real", "imag",
+]
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    return Tensor(data, dtype=dtypes.convert_dtype(dtype), place=place,
+                  stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.zeros_like(a, dtype=dtypes.convert_dtype(dtype)), _sg(x), name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.ones_like(a, dtype=dtypes.convert_dtype(dtype)), _sg(x), name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.full_like(a, fill_value, dtype=dtypes.convert_dtype(dtype)), _sg(x), name="full_like")
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or "float32"
+    d = dtypes.convert_dtype(dtype) if dtype else jnp.int64
+    if d == jnp.int64 and not jax.config.read("jax_enable_x64"):
+        d = jnp.int32
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def _diag(a):
+        out = jnp.diag(a, offset)
+        if a.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(out.shape[0], k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return apply(_diag, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.diagflat(a, offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.tril(a, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.triu(a, diagonal), x, name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t.data if isinstance(t, Tensor) else jnp.asarray(t) for t in tensors],
+                        indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    src = Tensor(x) if not isinstance(x, Tensor) else x
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a, src, name="assign")
+    if output is not None:
+        output._adopt(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(np.int64(x.size))
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply(lambda r, i: r + 1j * i, real, imag, name="complex")
+
+
+def real(x, name=None) -> Tensor:
+    return apply(jnp.real, x, name="real")
+
+
+def imag(x, name=None) -> Tensor:
+    return apply(jnp.imag, x, name="imag")
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _sg(x):
+    return x if isinstance(x, Tensor) else Tensor(x)

@@ -1,0 +1,210 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, linalg.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "norm", "matmul", "dist", "cond", "cholesky", "cholesky_solve", "svd",
+    "qr", "lu", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
+    "matrix_power", "det", "slogdet", "inv", "inverse", "pinv", "solve",
+    "triangular_solve", "lstsq", "multi_dot", "cross", "histogram", "bincount",
+    "mv", "corrcoef", "cov",
+]
+
+from .math import matmul  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == np.inf or p == "inf":
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(_norm, _t(x), name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_t(x) - _t(y), p=float(p) if p not in ("fro", "inf") else p)
+
+
+def cond(x, p=None, name=None):
+    p = p or 2
+    def _cond(a):
+        if p == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        return jnp.linalg.norm(a, ord=p, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1))
+    return apply(_cond, _t(x), name="cond")
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(_chol, _t(x), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cs(b, chol):
+        c = jnp.swapaxes(chol, -1, -2) if upper else chol
+        z = jax.scipy.linalg.solve_triangular(c, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(c, -1, -2), z, lower=False)
+    return apply(_cs, _t(x), _t(y), name="cholesky_solve")
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), _t(x), name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: jnp.linalg.qr(a, mode=mode), _t(x), name="qr")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv.astype(jnp.int32)
+    outs = apply(_lu, _t(x), name="lu")
+    if get_infos:
+        return outs[0], outs[1], Tensor(np.zeros((), np.int32))
+    return outs
+
+
+def eig(x, name=None):
+    # General eig is CPU-only in XLA; host round-trip.
+    arr = np.asarray(_t(x).data)
+    w, v = np.linalg.eig(arr)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(_t(x).data)
+    return Tensor(np.linalg.eigvals(arr))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), _t(x), name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x), name="eigvalsh")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def _mr(a):
+        return jnp.linalg.matrix_rank(a, rtol=tol)
+    return apply(_mr, _t(x), name="matrix_rank")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), _t(x), name="matrix_power")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _t(x), name="det")
+
+
+def slogdet(x, name=None):
+    def _sld(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(_sld, _t(x), name="slogdet")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, _t(x), name="inv")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                 _t(x), name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _t(x), _t(y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _ts(a, b):
+        a2 = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            a2, b, lower=not upper, unit_diagonal=unitriangular)
+    return apply(_ts, _t(x), _t(y), name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply(_lstsq, _t(x), _t(y), name="lstsq")
+
+
+def multi_dot(x, name=None):
+    tensors = [_t(i) for i in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors, name="multi_dot")
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(_cross, _t(x), _t(y), name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _hist(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int32)
+    return apply(_hist, _t(input), name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(_t(x).data)
+    w = np.asarray(weights.data) if isinstance(weights, Tensor) else weights
+    return Tensor(np.bincount(arr, weights=w, minlength=minlength))
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, v: jnp.matmul(a, v), _t(x), _t(vec), name="mv")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x), name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                 _t(x), name="cov")

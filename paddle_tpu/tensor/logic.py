@@ -1,0 +1,125 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "is_empty", "is_tensor", "where",
+    "masked_select", "nonzero",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _cmp(fn, x, y, name):
+    if isinstance(y, (int, float, bool, np.number)):
+        return apply(lambda a: fn(a, y), _t(x), name=name)
+    return apply(fn, _t(x), _t(y), name=name)
+
+
+def equal(x, y, name=None):
+    return _cmp(jnp.equal, x, y, "equal")
+
+
+def not_equal(x, y, name=None):
+    return _cmp(jnp.not_equal, x, y, "not_equal")
+
+
+def greater_than(x, y, name=None):
+    return _cmp(jnp.greater, x, y, "greater_than")
+
+
+def greater_equal(x, y, name=None):
+    return _cmp(jnp.greater_equal, x, y, "greater_equal")
+
+
+def less_than(x, y, name=None):
+    return _cmp(jnp.less, x, y, "less_than")
+
+
+def less_equal(x, y, name=None):
+    return _cmp(jnp.less_equal, x, y, "less_equal")
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y), name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 _t(x), _t(y), name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 _t(x), _t(y), name="isclose")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp(jnp.logical_and, x, y, "logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp(jnp.logical_or, x, y, "logical_or")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp(jnp.logical_xor, x, y, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, _t(x), name="logical_not")
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_and, x, y, "bitwise_and")
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_or, x, y, "bitwise_or")
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_xor, x, y, "bitwise_xor")
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, _t(x), name="bitwise_not")
+
+
+def is_empty(x, name=None):
+    return Tensor(np.bool_(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply(lambda c, a, b: jnp.where(c, a, b), _t(condition), _t(x), _t(y), name="where")
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: host round-trip (eager only).
+    arr = np.asarray(_t(x).data)
+    m = np.asarray(_t(mask).data).astype(bool)
+    return Tensor(arr[m])
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_t(x).data)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=1).astype(np.int64))

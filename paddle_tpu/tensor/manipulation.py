@@ -1,0 +1,358 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "reshape", "flatten", "transpose", "squeeze", "unsqueeze", "concat",
+    "stack", "unstack", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "take_along_axis",
+    "put_along_axis", "roll", "flip", "rot90", "unique", "unique_consecutive",
+    "unbind", "slice", "strided_slice", "crop", "pad", "shard_index",
+    "repeat_interleave", "moveaxis", "as_complex", "as_real", "tensordot",
+    "tolist", "cast",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in np.asarray(v.data).reshape(-1))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def reshape(x, shape, name=None):
+    shp = _ints(shape)
+    return apply(lambda a: jnp.reshape(a, shp), _t(x), name="reshape")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flat(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(_flat, _t(x), name="flatten")
+
+
+def transpose(x, perm, name=None):
+    p = _ints(perm)
+    return apply(lambda a: jnp.transpose(a, p), _t(x), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), _t(x), name="moveaxis")
+
+
+def squeeze(x, axis=None, name=None):
+    def _sq(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(_sq, _t(x), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    def _usq(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(_usq, _t(x), name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(i) for i in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=ax), *tensors, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(i) for i in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num or x.shape[axis]
+    outs = apply(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                 x, name="unstack")
+    return list(outs)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_neg = builtins_sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - builtins_sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def _split(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax) for o, s in zip(offsets, sizes))
+
+    return list(apply(_split, x, name="split"))
+
+
+def builtins_sum(it, start=0):
+    total = start
+    for v in it:
+        total = total + v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), _t(x), name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = _ints(shape)
+    def _exp(a):
+        tgt = list(shp)
+        # -1 means keep original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply(_exp, _t(x), name="expand")
+
+
+def expand_as(x, y, name=None):
+    y_shape = tuple(_t(y).shape)
+    return apply(lambda a: jnp.broadcast_to(a, y_shape), _t(x), name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    shp = _ints(shape)
+    return apply(lambda a: jnp.broadcast_to(a, shp), _t(x), name="broadcast_to")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda a, i: jnp.take(a, i.reshape(-1).astype(jnp.int32), axis=ax),
+                 _t(x), _t(index), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _gnd(a, idx):
+        idx = idx.astype(jnp.int32)
+        lead = idx.shape[:-1]
+        k = idx.shape[-1]
+        flat_idx = idx.reshape(-1, k)
+        out = a[tuple(flat_idx[:, i] for i in range(k))]
+        return out.reshape(lead + a.shape[k:])
+    return apply(_gnd, _t(x), _t(index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _sc(a, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply(_sc, _t(x), _t(index), _t(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, idx, u):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = idx.reshape(-1, k)
+        flat_u = u.reshape((-1,) + a.shape[k:])
+        return a.at[tuple(flat_idx[:, i] for i in range(k))].add(flat_u)
+    return apply(_snd, _t(x), _t(index), _t(updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=_t(updates).dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+                 _t(x), _t(index), name="index_sample")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                 _t(arr), _t(indices), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def _put(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        # build full index tuple
+        idx = []
+        for d in range(a.ndim):
+            if d == axis:
+                idx.append(i)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                idx.append(jnp.broadcast_to(jnp.arange(a.shape[d]).reshape(shape), i.shape))
+        if reduce == "add":
+            return a.at[tuple(idx)].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[tuple(idx)].multiply(v)
+        return a.at[tuple(idx)].set(v)
+    return apply(_put, _t(arr), _t(indices), _t(values), name="put_along_axis")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), _t(x), name="roll")
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return apply(lambda a: jnp.flip(a, axis=axes), _t(x), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x), name="rot90")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Unique has data-dependent output shape: eager-only (host round-trip),
+    # mirroring the reference's CPU/GPU sync in unique_op.
+    arr = np.asarray(_t(x).data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(_t(x).data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0] if axis is None else arr.shape[axis], dtype=bool)
+    a = arr if axis is None else np.moveaxis(arr, axis, 0)
+    for i in range(1, a.shape[0]):
+        keep[i] = not np.array_equal(a[i], a[i - 1])
+    out = a[keep]
+    outs = [Tensor(out if axis is None else np.moveaxis(out, 0, axis))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[0]))
+        outs.append(Tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    def _slice(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = np.s_[s:e]
+        return a[tuple(idx)]
+    return apply(_slice, _t(input), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    strides = _ints(strides)
+    def _ss(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = np.s_[s:e:st]
+        return a[tuple(idx)]
+    return apply(_ss, _t(x), name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * len(shp)
+    def _crop(a):
+        idx = tuple(np.s_[o:o + s] for o, s in zip(offs, shp))
+        return a[idx]
+    return apply(_crop, _t(x), name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn.functional import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def _si(i):
+        shard = i // size
+        return jnp.where(shard == shard_id, i % size, ignore_value)
+    return apply(_si, _t(input), name="shard_index")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.data)
+        def _ri(a):
+            return jnp.repeat(a, reps, axis=axis, total_repeat_length=int(reps.sum()))
+        return apply(_ri, _t(x), name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), _t(x), name="repeat_interleave")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x), name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x), name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y), name="tensordot")
+
+
+def tolist(x):
+    return _t(x).tolist()

@@ -1,0 +1,371 @@
+"""Math ops (reference surface: python/paddle/tensor/math.py, ops.py).
+
+Every op is a jnp composition dispatched through core.tensor.apply so the
+eager tape records its VJP. Under jit tracing the same code path runs on
+tracer arrays (tape off) and XLA fuses the compositions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "matmul", "mm", "bmm", "dot", "inner", "outer", "t", "transpose_",
+    "scale", "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "reciprocal", "sign", "floor", "ceil", "round",
+    "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "atan2", "erf", "erfinv", "lgamma",
+    "digamma", "sum", "mean", "max", "min", "prod", "amax", "amin",
+    "logsumexp", "cumsum", "cumprod", "clip", "maximum", "minimum", "fmax",
+    "fmin", "add_n", "multiplex", "isnan", "isinf", "isfinite", "nan_to_num",
+    "stanh", "kron", "trace", "all", "any", "broadcast_shape", "lerp",
+    "rad2deg", "deg2rad", "gcd", "lcm", "diff", "angle", "frac",
+    "count_nonzero", "nansum", "nanmean", "heaviside", "logit", "increment",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _binop(fn, x, y, name):
+    # Promote python scalars without creating spurious tensors.
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if isinstance(y, (int, float, bool, np.number)):
+        return apply(lambda a: fn(a, y), x, name=name)
+    y = _t(y)
+    return apply(fn, x, y, name=name)
+
+
+def add(x, y, name=None):
+    return _binop(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return _binop(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binop(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return _binop(jnp.true_divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binop(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return _binop(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return _binop(jnp.power, x, y, "pow")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(_mm, _t(x), _t(y), name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, _t(x), _t(y), name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y), name="dot")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _t(x), _t(y), name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), _t(x), _t(y), name="outer")
+
+
+def t(x, name=None):
+    return apply(lambda a: a.T, _t(x), name="t")
+
+
+def transpose_(x, perm, name=None):
+    return apply(lambda a: jnp.transpose(a, perm), _t(x), name="transpose")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    def _scale(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = apply(_scale, _t(x), name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    new = apply(lambda a: a + value, x, name="increment")
+    x._adopt(new)
+    return x
+
+
+def _unary(fn, name):
+    def op(x, name=None):
+        return apply(fn, _t(x), name=name or op.__name__)
+    op.__name__ = name
+    return op
+
+
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sign = _unary(jnp.sign, "sign")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+angle = _unary(jnp.angle, "angle")
+
+
+def frac(x, name=None):
+    return apply(lambda a: a - jnp.trunc(a), _t(x), name="frac")
+
+
+def atan2(x, y, name=None):
+    return _binop(jnp.arctan2, x, y, "atan2")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x), name="stanh")
+
+
+def logit(x, eps=None, name=None):
+    def _logit(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply(_logit, _t(x), name="logit")
+
+
+def heaviside(x, y, name=None):
+    return _binop(jnp.heaviside, x, y, "heaviside")
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.sum(a, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                 _t(x), name="sum")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.nansum(a, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                 _t(x), name="nansum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="mean")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.prod(a, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                 _t(x), name="prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    def _cs(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+    return apply(_cs, _t(x), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=d), _t(x), name="cumprod")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), _t(x), name="clip")
+
+
+def maximum(x, y, name=None):
+    return _binop(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return _binop(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return _binop(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return _binop(jnp.fmin, x, y, "fmin")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = [_t(i) for i in inputs]
+    return apply(lambda *arrs: jnp.sum(jnp.stack(arrs), axis=0) if len(arrs) > 1 else arrs[0],
+                 *tensors, name="add_n")
+
+
+def multiplex(inputs, index, name=None):
+    tensors = [_t(i) for i in inputs]
+    idx = _t(index)
+    def _mux(ix, *arrs):
+        stacked = jnp.stack(arrs)  # [n, batch, ...]
+        rows = ix.reshape(-1).astype(jnp.int32)
+        batch = jnp.arange(stacked.shape[1])
+        return stacked[rows, batch]
+    return apply(_mux, idx, *tensors, name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 _t(x), name="nan_to_num")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _t(x), _t(y), name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset, axis1, axis2), _t(x), name="trace")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), name="count_nonzero")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), _t(x), _t(y), name="lerp")
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, _t(x), name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    return apply(jnp.deg2rad, _t(x), name="deg2rad")
+
+
+def gcd(x, y, name=None):
+    return _binop(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return _binop(jnp.lcm, x, y, "lcm")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend.data if isinstance(prepend, Tensor) else prepend
+    app = append.data if isinstance(append, Tensor) else append
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 _t(x), name="diff")

@@ -1,0 +1,130 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Eager calls draw fresh subkeys from the global Generator; inside a jit trace
+the context trace-key is used (see core/random.py) so traced steps stay pure.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.random import make_rng
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _dt(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = make_rng()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = make_rng()
+        return Tensor(jax.random.normal(key, shp) * s + m)
+    key = make_rng()
+    return Tensor(jax.random.normal(key, _shape(shape or [1])) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else make_rng()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = make_rng()
+    d = dtypes.convert_dtype(dtype)
+    if d == jnp.int64 and not jax.config.read("jax_enable_x64"):
+        d = jnp.int32
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, shape=tuple(x.shape), dtype=dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    key = make_rng()
+    d = dtypes.convert_dtype(dtype)
+    if d == jnp.int64 and not jax.config.read("jax_enable_x64"):
+        d = jnp.int32
+    return Tensor(jax.random.permutation(key, n).astype(d))
+
+
+def bernoulli(x, name=None):
+    key = make_rng()
+    return apply(lambda a: jax.random.bernoulli(key, a).astype(a.dtype), x, name="bernoulli")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = make_rng()
+    def _mn(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=(num_samples,) + p.shape[:-1]).T \
+                if p.ndim > 1 else jax.random.categorical(key, logits, shape=(num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return apply(_mn, x, name="multinomial")
+
+
+def poisson(x, name=None):
+    key = make_rng()
+    return apply(lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), x, name="poisson")
+
+
+# In-place variants mutate the tensor's value (paddle `tensor.uniform_()` UX).
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else make_rng()
+    x._data = jax.random.uniform(key, tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = make_rng()
+    x._data = (jax.random.normal(key, tuple(x.shape), x.dtype) * std + mean).astype(x.dtype)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = make_rng()
+    x._data = (jax.random.exponential(key, tuple(x.shape), x.dtype) / lam).astype(x.dtype)
+    return x

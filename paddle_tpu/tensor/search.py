@@ -1,0 +1,111 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "index_sample", "masked_select", "where", "nonzero", "searchsorted",
+    "bucketize",
+]
+
+from .logic import masked_select, nonzero, where  # re-export
+from .manipulation import index_sample  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(jnp.int32),
+                 _t(x), name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(jnp.int32),
+                 _t(x), name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return apply(lambda a: jnp.argsort(a, axis=axis, descending=descending),
+                 _t(x), name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply(lambda a: jnp.sort(a, axis=axis, descending=descending),
+                 _t(x), name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else axis
+
+    def _topk(a):
+        src = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(src, k)
+        else:
+            vals, idx = jax.lax.top_k(-src, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    return apply(_topk, _t(x), name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    return apply(_kth, _t(x), name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        same = jnp.concatenate(
+            [jnp.ones_like(jnp.take(srt, jnp.array([0]), axis=axis), dtype=jnp.int32),
+             (jnp.diff(srt, axis=axis) == 0).astype(jnp.int32)], axis=axis)
+        # run lengths via cumulative trick
+        runs = jnp.cumsum(same, axis=axis) * same
+        pos = jnp.argmax(runs, axis=axis, keepdims=True)
+        vals = jnp.take_along_axis(srt, pos, axis=axis)
+        inds = jnp.take_along_axis(idx, pos, axis=axis)
+        if not keepdim:
+            vals = jnp.squeeze(vals, axis)
+            inds = jnp.squeeze(inds, axis)
+        return vals, inds
+    return apply(_mode, _t(x), name="mode")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out_dtype = jnp.int32
+
+    def _ss(seq, v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(out_dtype)
+
+    return apply(_ss, _t(sorted_sequence), _t(values), name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

@@ -1,0 +1,51 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile", "nanquantile"]
+
+from .math import mean  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x), name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                        keepdims=keepdim), _t(x), name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim), _t(x), name="nanquantile")

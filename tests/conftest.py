@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Force an 8-device virtual CPU mesh BEFORE jax initialises, per SURVEY.md §4's
+test strategy: multi-device distributed tests run on one host (the analogue
+of the reference's multi-process localhost tests, test_dist_base.py:778).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# NOTE: this JAX build lowers f32 matmuls to bf16 passes by default
+# (TPU-style). Do NOT globally raise jax_default_matmul_precision here — on
+# this CPU backend non-default precision makes conv compiles ~10x slower.
+# Numeric-gradient checks raise precision locally (see op_test.check_grad).
+
+# Persistent compilation cache: XLA:CPU compiles dominate suite runtime;
+# warm runs hit disk instead of recompiling.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

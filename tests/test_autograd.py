@@ -1,0 +1,140 @@
+"""Eager autograd engine tests (BasicEngine analogue coverage)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_and_fanout():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3.0
+    b = x * 5.0
+    y = a + b  # dy/dx = 8
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient default True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3, 4])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = (y * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_backward_through_getitem_and_concat():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    y = paddle.concat([x[0:1], x[2:3]], axis=0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 1], [0, 0], [1, 1]])
+
+
+def test_multi_output_op_backward():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 1]])
+
+
+def test_matmul_grad_numeric():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 2).astype(np.float32)
+    check_grad(paddle.matmul, [a, b], input_idx=0)
+    check_grad(paddle.matmul, [a, b], input_idx=1)
+
+
+def test_tanh_exp_grads_numeric():
+    x = np.random.randn(5).astype(np.float32) * 0.5
+    check_grad(paddle.tanh, [x])
+    check_grad(paddle.exp, [x])
+
+
+def test_softmax_grad_numeric():
+    import paddle_tpu.nn.functional as F
+    x = np.random.randn(3, 5).astype(np.float32)
+    check_grad(F.softmax, [x], rtol=2e-2, atol=2e-3)
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = {}
+
+    def hook(g):
+        seen["grad"] = g.numpy().copy()
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(seen["grad"], [3, 3])
+    np.testing.assert_allclose(x.grad.numpy(), [6, 6])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    z = y * 3
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])

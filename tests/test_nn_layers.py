@@ -1,0 +1,258 @@
+"""nn layer tests (reference pattern: test_layers.py, test_transformer_api.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_values():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = lin(x)
+    assert out.shape == [2, 3]
+    expected = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+
+def test_conv2d_against_manual():
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    out = conv(x)
+    assert out.shape == [1, 4, 8, 8]
+    # stride/padding variants
+    out2 = nn.Conv2D(2, 4, 3, stride=2)(x)
+    assert out2.shape == [1, 4, 3, 3]
+
+
+def test_conv2d_groups_depthwise():
+    conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+    x = paddle.randn([2, 4, 6, 6])
+    assert conv(x).shape == [2, 4, 6, 6]
+
+
+def test_conv_transpose():
+    convt = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+    x = paddle.randn([1, 3, 8, 8])
+    assert convt(x).shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3 + 1
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8]) * 5 + 2
+    out = ln(x)
+    np.testing.assert_allclose(out.numpy().mean(-1), np.zeros((2, 4)), atol=1e-4)
+    np.testing.assert_allclose(out.numpy().std(-1), np.ones((2, 4)), atol=1e-2)
+
+
+def test_groupnorm_instance_norm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 5, 5])
+    assert gn(x).shape == [2, 4, 5, 5]
+    inorm = nn.InstanceNorm2D(4)
+    assert inorm(x).shape == [2, 4, 5, 5]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 6)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]), dtype="int64")
+    out = emb(ids)
+    assert out.shape == [2, 2, 6]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([0, 1]), dtype="int64")
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    do.train()
+    out = do(x)
+    frac_zero = (out.numpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # upscale keeps expectation
+    np.testing.assert_allclose(out.numpy().mean(), 1.0, atol=0.1)
+    do.eval()
+    np.testing.assert_array_equal(do(x).numpy(), x.numpy())
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D((1, 1))(x).numpy().reshape(2),
+        x.numpy().mean(axis=(0, 2, 3)), rtol=1e-5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 0, 0.5, 2])
+    assert nn.GELU()(x).shape == [5]
+    assert nn.Sigmoid()(x).numpy()[2] == 0.5
+    np.testing.assert_allclose(nn.LeakyReLU(0.1)(x).numpy(),
+                               [-0.2, -0.05, 0, 0.5, 2], rtol=1e-6)
+    sm = F.softmax(paddle.randn([3, 5]))
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_losses():
+    logits = paddle.randn([4, 10], dtype="float32")
+    labels = paddle.to_tensor(np.array([1, 2, 3, 4]), dtype="int64")
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    # numpy reference
+    x = logits.numpy().astype(np.float64)
+    p = np.exp(x - x.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expected = -np.log(p[np.arange(4), [1, 2, 3, 4]]).mean()
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+
+    a, b = paddle.randn([3, 4]), paddle.randn([3, 4])
+    np.testing.assert_allclose(float(nn.MSELoss()(a, b)),
+                               ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(nn.L1Loss()(a, b)),
+                               np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    logits = paddle.randn([4, 6])
+    labels = paddle.to_tensor(np.array([1, -100, 3, -100]), dtype="int64")
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    x = logits.numpy().astype(np.float64)
+    p = np.exp(x - x.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expected = -np.log(p[[0, 2], [1, 3]]).mean()
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+    sm = F.cross_entropy(logits, paddle.to_tensor(np.array([1, 2, 3, 0]),
+                                                  dtype="int64"),
+                         label_smoothing=0.1)
+    assert np.isfinite(float(sm))
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll)) == 3
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert len(sd) == 4  # 2 weights + 2 biases
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    for (k1, v1), (k2, v2) in zip(net.state_dict().items(),
+                                  net2.state_dict().items()):
+        np.testing.assert_array_equal(v1.numpy(), v2.numpy())
+
+
+def test_named_parameters_unique():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    names = [n for n, _ in net.named_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_multi_head_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 5, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+    # with mask
+    mask = paddle.ones([2, 4, 5, 5], dtype="float32") * 0.0
+    out2 = mha(q, q, q, attn_mask=mask)
+    assert out2.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    src = paddle.randn([2, 6, 16])
+    assert enc(src).shape == [2, 6, 16]
+    # parameters are independent across stacked layers
+    p = list(enc.named_parameters())
+    assert len(p) == 2 * len(list(layer.named_parameters()))
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 4, 16]
+
+
+def test_rnn_lstm_gru():
+    x = paddle.randn([2, 7, 5])
+    lstm = nn.LSTM(5, 8)
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 7, 8]
+    assert h.shape == [1, 2, 8] and c.shape == [1, 2, 8]
+    gru = nn.GRU(5, 8, direction="bidirect")
+    out2, h2 = gru(x)
+    assert out2.shape == [2, 7, 16]
+    rnn = nn.SimpleRNN(5, 8, num_layers=2)
+    out3, h3 = rnn(x)
+    assert out3.shape == [2, 7, 8]
+
+
+def test_rnn_grad_flows():
+    lstm = nn.LSTM(4, 6)
+    x = paddle.randn([2, 5, 4])
+    out, _ = lstm(x)
+    out.sum().backward()
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_layer_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_pad_and_interpolate():
+    x = paddle.randn([1, 2, 4, 4])
+    assert F.pad(x, [1, 1, 2, 2]).shape == [1, 2, 8, 6]
+    assert F.interpolate(x, size=[8, 8], mode="nearest").shape == [1, 2, 8, 8]
+    assert F.interpolate(x, scale_factor=2, mode="bilinear").shape == [1, 2, 8, 8]
+
+
+def test_one_hot_and_sequence_mask():
+    ids = paddle.to_tensor(np.array([0, 2]), dtype="int64")
+    oh = F.one_hot(ids, 4)
+    np.testing.assert_array_equal(oh.numpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+    lens = paddle.to_tensor(np.array([1, 3]), dtype="int64")
+    m = F.sequence_mask(lens, maxlen=4)
+    np.testing.assert_array_equal(m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])

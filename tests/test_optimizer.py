@@ -1,0 +1,129 @@
+"""Optimizer tests (reference pattern: test_adam_op.py, test_sgd_op.py …)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _quadratic_problem(opt_factory, steps=60):
+    """Minimise ||Wx - y||^2 on an exactly-solvable system; returns final loss."""
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = opt_factory(net.parameters())
+    x = paddle.randn([4, 4])
+    target = paddle.randn([4, 4])
+    loss_val = None
+    for _ in range(steps):
+        loss = ((net(x) - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        loss_val = float(loss)
+    return loss_val
+
+
+def test_sgd_converges():
+    final = _quadratic_problem(
+        lambda ps: paddle.optimizer.SGD(learning_rate=0.1, parameters=ps))
+    assert final < 0.2
+
+
+def test_momentum_converges():
+    final = _quadratic_problem(
+        lambda ps: paddle.optimizer.Momentum(learning_rate=0.02, momentum=0.9,
+                                             parameters=ps))
+    assert final < 0.2
+
+
+def test_adam_converges():
+    final = _quadratic_problem(
+        lambda ps: paddle.optimizer.Adam(learning_rate=0.1, parameters=ps))
+    assert final < 0.2
+
+
+def test_adamw_decay():
+    # with pure decay and zero grads, weights shrink
+    p = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=p.parameters())
+    w0 = np.abs(p.weight.numpy()).sum()
+    x = paddle.zeros([1, 2])
+    (p(x).sum() * 0.0).backward()
+    opt.step()
+    assert np.abs(p.weight.numpy()).sum() < w0
+
+
+def test_adam_matches_reference_formula():
+    w = paddle.core.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    w.grad = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+    opt.step()
+    # manual adam step 1
+    g = np.array([0.5, -0.5])
+    m = 0.1 * g
+    v = 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expected = np.array([1.0, 2.0]) - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expected, rtol=1e-5)
+
+
+def test_lamb_and_rmsprop_run():
+    for factory in [
+        lambda ps: paddle.optimizer.Lamb(learning_rate=0.01, parameters=ps),
+        lambda ps: paddle.optimizer.RMSProp(learning_rate=0.01, parameters=ps),
+        lambda ps: paddle.optimizer.Adagrad(learning_rate=0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Adadelta(learning_rate=1.0, parameters=ps),
+        lambda ps: paddle.optimizer.Adamax(learning_rate=0.05, parameters=ps),
+    ]:
+        final = _quadratic_problem(factory, steps=80)
+        assert np.isfinite(final)
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.optimizer import ClipGradByGlobalNorm
+    w = paddle.core.Parameter(np.zeros(4, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                               grad_clip=ClipGradByGlobalNorm(1.0))
+    w.grad = paddle.to_tensor(np.array([10.0, 0, 0, 0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(np.abs(w.numpy()).sum(), 1.0, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer.lr import (CosineAnnealingDecay, LinearWarmup,
+                                         MultiStepDecay, NoamDecay, StepDecay)
+    s = StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    w = LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(w())
+        w.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+
+    c = CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(c() - 1.0) < 1e-6
+
+    opt = paddle.optimizer.SGD(learning_rate=s)
+    assert opt.get_lr() == s()
+
+
+def test_optimizer_state_dict_roundtrip():
+    net = nn.Linear(3, 3)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    x = paddle.randn([4, 3])
+    (net(x).sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["_step_count"] == 1
+
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1

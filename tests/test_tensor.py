@@ -1,0 +1,138 @@
+"""Tensor API tests (modelled on reference test_math_op_patch.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+    assert paddle.eye(3).numpy().trace() == 3
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((10 - a).numpy(), [9, 8, 7])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    c = paddle.matmul(a, b)
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    d = paddle.matmul(b, a, transpose_x=True, transpose_y=True)
+    np.testing.assert_allclose(d.numpy(), b.numpy().T @ a.numpy().T, rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t.sum().numpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(t.mean(axis=0).numpy(), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(t.max(axis=1).numpy(), x.max(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t).numpy(),
+                               np.log(np.exp(x.astype(np.float64)).sum()),
+                               rtol=1e-4)
+
+
+def test_manipulation():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    cc = paddle.concat([t, t], axis=2)
+    assert cc.shape == [2, 3, 8]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+
+
+def test_indexing_and_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(t[:, 2].numpy(), [2, 6, 10])
+    t[0, 0] = 100.0
+    assert t.numpy()[0, 0] == 100.0
+
+
+def test_comparison_and_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a > b).numpy(), [False, False, True])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    assert bool(paddle.allclose(a, a))
+    np.testing.assert_array_equal(
+        paddle.where(a > b, a, b).numpy(), [3, 2, 3])
+
+
+def test_search_ops():
+    x = np.random.randn(4, 6).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+    vals, idx = paddle.topk(t, 3, axis=1)
+    np.testing.assert_allclose(vals.numpy(), -np.sort(-x, axis=1)[:, :3], rtol=1e-6)
+    s = paddle.sort(t, axis=1)
+    np.testing.assert_allclose(s.numpy(), np.sort(x, 1), rtol=1e-6)
+
+
+def test_cast_and_astype():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert str(t.astype("int32").dtype) == "int32"
+    assert str(t.astype(paddle.float16).dtype) == "float16"
+    bf = t.astype("bfloat16")
+    assert "bfloat16" in str(bf.dtype)
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    L = paddle.cholesky(t)
+    np.testing.assert_allclose((L @ L.t()).numpy(), spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.inv(t).numpy(), np.linalg.inv(spd),
+                               rtol=1e-3, atol=1e-4)
+    u, s, vt = paddle.svd(paddle.to_tensor(a))
+    np.testing.assert_allclose(
+        (u @ paddle.diag(s) @ vt).numpy(), a, rtol=1e-3, atol=1e-4)
+
+
+def test_random_reproducibility():
+    paddle.seed(7)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = paddle.randn([4, 4]).numpy()
+    assert not np.array_equal(b, c)
+
+
+def test_stat_ops():
+    x = np.random.randn(5, 7).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(paddle.var(t, axis=0).numpy(), x.var(0, ddof=1),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.median(t).numpy(), np.median(x), rtol=1e-5)
