@@ -31,6 +31,7 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled: set = set()  # ids of optimizers unscaled this step
 
     def is_enable(self):
         return self._enable
@@ -41,10 +42,21 @@ class AmpScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        """check_finite_and_unscale over the optimizer's param grads."""
+        """check_finite_and_unscale over the optimizer's param grads.
+
+        Guarded against double-unscaling within one step (reference:
+        amp/grad_scaler.py:198 checks OptimizerState before unscaling), so
+        the documented ``unscale_ -> clip -> step`` pattern divides by the
+        loss scale exactly once.
+        """
         if not self._enable:
             self._found_inf = False
             return
+        if id(optimizer) in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        self._unscaled.add(id(optimizer))
         params = [p for p in optimizer._ensure_params() if p.grad is not None]
         if not params:
             self._found_inf = False
@@ -68,12 +80,14 @@ class AmpScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
         self.update()
 
     def update(self):
+        self._unscaled.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -82,5 +82,17 @@ define_flag("eager_jit_ops", True, "Cache-jit elementary eager ops.")
 define_flag("default_dtype", "float32", "Default floating dtype.")
 define_flag("allocator_strategy", "xla", "Kept for API parity; XLA owns HBM on TPU.")
 define_flag("check_finite", False, "Check gradients finite after backward.")
-define_flag("tpu_matmul_precision", "default", "jax default_matmul_precision.")
+define_flag("tpu_matmul_precision", "highest",
+            "Precision for f32 dot ops (matmul/linear/einsum/attention). "
+            "'highest' = full f32 (reference CUDA parity); 'default' lets the "
+            "backend pick (bf16 passes on TPU). Convolutions follow the XLA "
+            "backend default; use AMP/bf16 for the MXU fast path.")
 define_flag("log_level", "0", "Verbose log level (VLOG analogue).")
+
+
+def matmul_precision():
+    """Resolve the tpu_matmul_precision flag to a jax `precision=` value."""
+    v = get_flag("tpu_matmul_precision")
+    if v in (None, "", "default"):
+        return None
+    return v

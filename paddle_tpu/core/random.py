@@ -104,8 +104,12 @@ _STREAMS = {}
 
 def _stream_id(name: str) -> int:
     if name not in _STREAMS:
-        # Stable id per stream name within a process.
-        _STREAMS[name] = (hash(name) & 0x7FFFFFFF) or 1
+        # Deterministic across processes/runs (python's str hash is salted
+        # per-process; named streams like 'global_seed' must agree across
+        # model-parallel ranks).
+        import hashlib
+        digest = hashlib.sha256(name.encode()).digest()
+        _STREAMS[name] = (int.from_bytes(digest[:4], "little") & 0x7FFFFFFF) or 1
     return _STREAMS[name]
 
 

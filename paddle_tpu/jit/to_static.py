@@ -105,17 +105,17 @@ class StaticFunction:
 
         pure = self._pure(treedef, kwargs)
 
-        def fwd_only(t_a, flat_in):
-            out, bufs = pure({**frozen, **t_a}, b_arrays, key, flat_in)
-            return out, bufs
-
         out_arrays, new_bufs = jitted(p_arrays, b_arrays, key, flat_inputs)
 
         bwd = self._bwd_cache.get(sig)
         if bwd is None:
-            def bwd_fn(t_a, flat_in, cotangents):
+            # key/buffers/frozen are explicit arguments (NOT closed over):
+            # the cached executable must rematerialize the forward with the
+            # *current* call's RNG key and buffers, or dropout masks in the
+            # recomputed forward would come from the first call.
+            def bwd_fn(t_a, frozen_a, b_a, k, flat_in, cotangents):
                 def f(t_a_inner, flat_inner):
-                    out, _ = fwd_only(t_a_inner, flat_inner)
+                    out, _ = pure({**frozen_a, **t_a_inner}, b_a, k, flat_inner)
                     return out
                 _, vjp = jax.vjp(f, t_a, flat_in)
                 return vjp(cotangents)
@@ -131,11 +131,14 @@ class StaticFunction:
         out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
 
         captured_inputs = list(flat_inputs)
+        captured_key = key
+        captured_bufs = b_arrays
 
         def vjp_fn(cots):
             cot_list = list(cots) if isinstance(cots, tuple) else [cots]
             cot_tree = jax.tree_util.tree_unflatten(out_treedef, cot_list)
-            g_params, g_inputs = bwd(t_arrays, captured_inputs, cot_tree)
+            g_params, g_inputs = bwd(t_arrays, frozen, captured_bufs,
+                                     captured_key, captured_inputs, cot_tree)
             grads = [g_params[k] for k in t_params.keys()]
             # map input grads back to diff tensor positions
             flat_gin, _ = jax.tree_util.tree_flatten(g_inputs)

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtypes
+from ..core.flags import matmul_precision
 from ..core.random import in_trace_rng, make_rng
 from ..core.tensor import Tensor, apply
 
@@ -213,10 +214,12 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. Weight layout [in, out] (reference: nn/functional/common.py linear)."""
+    prec = matmul_precision()
     if bias is None:
-        return apply(lambda a, w: jnp.matmul(a, w), _t(x), _t(weight), name="linear")
-    return apply(lambda a, w, b: jnp.matmul(a, w) + b, _t(x), _t(weight), _t(bias),
-                 name="linear")
+        return apply(lambda a, w: jnp.matmul(a, w, precision=prec),
+                     _t(x), _t(weight), name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w, precision=prec) + b,
+                 _t(x), _t(weight), _t(bias), name="linear")
 
 
 def _norm_tuple(v, n):
@@ -765,9 +768,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 loss = -jnp.take_along_axis(logp, safe_ids[..., None], axis=axis)[..., 0]
             loss = loss * valid
             if maybe_w:
-                loss = loss * jnp.take(maybe_w[0], safe_ids, axis=0) * valid
+                sample_w = jnp.take(maybe_w[0], safe_ids, axis=0) * valid
+                loss = loss * sample_w
+                # weighted mean divides by the gathered weight sum
+                # (reference: nn/functional/loss.py ret = out_sum / weight_sum)
+                valid = sample_w
         if reduction == "mean":
-            denom = jnp.maximum(jnp.sum(valid), 1.0)
+            denom = jnp.maximum(jnp.sum(valid), 1e-12)
             return jnp.sum(loss) / denom
         return _reduce(loss, reduction)
 
@@ -923,7 +930,7 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     def _np(a, p, y):
-        sim = jnp.matmul(a, p.T)
+        sim = jnp.matmul(a, p.T, precision=matmul_precision())
         y2 = (y[:, None] == y[None, :]).astype(jnp.float32)
         y2 = y2 / jnp.sum(y2, axis=1, keepdims=True)
         logp = jax.nn.log_softmax(sim, axis=1)
@@ -1251,12 +1258,14 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     def _sa(q, k, v, offs, cols):
         B, H, S, D = q.shape
         scale = 1.0 / math.sqrt(D)
-        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                            precision=matmul_precision()) * scale
         # build dense mask from CSR (host-side shapes, device gather)
         row_ids = jnp.repeat(jnp.arange(S), jnp.diff(offs[0, 0]), total_repeat_length=cols.shape[-1])
         mask = jnp.zeros((S, S), bool).at[row_ids, cols[0, 0]].set(True)
         scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v,
+                          precision=matmul_precision())
     return apply(_sa, _t(query), _t(key), _t(value), _t(sparse_csr_offset),
                  _t(sparse_csr_columns), name="sparse_attention")

@@ -266,11 +266,18 @@ class Layer:
         dest = destination if destination is not None else collections.OrderedDict()
         for name, p in self.named_parameters(prefix=structured_name_prefix):
             dest[name] = p
-        for name, b in self.named_buffers(prefix=structured_name_prefix):
-            short = name.rsplit(".", 1)[-1]
-            if short in self._non_persistable_buffer_names:
-                continue
-            dest[name] = b
+        # Persistability is owned by the registering sublayer, so filter on
+        # each sublayer's own _non_persistable_buffer_names.
+        seen = set()
+        for layer_name, layer in self.named_sublayers(
+                prefix=structured_name_prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                if bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[layer_name + ("." if layer_name else "") + bname] = b
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):

@@ -241,8 +241,10 @@ class Bilinear(Layer):
         from ...core.tensor import apply
         import jax.numpy as jnp
         args = [x1, x2, self.weight] + ([self.bias] if self.bias is not None else [])
+        from ...core.flags import matmul_precision
         def _bil(a, b, w, *mb):
-            out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+            out = jnp.einsum("bi,oij,bj->bo", a, w, b,
+                             precision=matmul_precision())
             if mb:
                 out = out + mb[0]
             return out

@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..core.flags import matmul_precision
 from ..core.random import make_rng
 from ..core.tensor import Tensor, apply
 
@@ -26,8 +27,9 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
     """Reference composition: works on [B, S, H, D]."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    prec = matmul_precision()
     scale = 1.0 / math.sqrt(D)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec) * scale
     if is_causal:
         causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
         scores = jnp.where(causal[None, None], scores, -1e30)
@@ -40,7 +42,7 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=prec)
 
 
 def _flash_supported(q, k, v, mask, dropout_p) -> bool:

@@ -170,8 +170,10 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def multi_dot(x, name=None):
+    from ..core.flags import matmul_precision
     tensors = [_t(i) for i in x]
-    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors, name="multi_dot")
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs, precision=matmul_precision()),
+                 *tensors, name="multi_dot")
 
 
 def cross(x, y, axis=9, name=None):
@@ -198,7 +200,9 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 
 def mv(x, vec, name=None):
-    return apply(lambda a, v: jnp.matmul(a, v), _t(x), _t(vec), name="mv")
+    from ..core.flags import matmul_precision
+    return apply(lambda a, v: jnp.matmul(a, v, precision=matmul_precision()),
+                 _t(x), _t(vec), name="mv")
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -351,7 +351,10 @@ def as_real(x, name=None):
 
 
 def tensordot(x, y, axes=2, name=None):
-    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y), name="tensordot")
+    from ..core.flags import matmul_precision
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes,
+                                            precision=matmul_precision()),
+                 _t(x), _t(y), name="tensordot")
 
 
 def tolist(x):

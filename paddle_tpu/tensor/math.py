@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import matmul_precision as _matmul_precision
 from ..core.tensor import Tensor, apply
 
 __all__ = [
@@ -80,7 +81,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
+        return jnp.matmul(a, b, precision=_matmul_precision())
     return apply(_mm, _t(x), _t(y), name="matmul")
 
 
@@ -88,7 +89,8 @@ mm = matmul
 
 
 def bmm(x, y, name=None):
-    return apply(jnp.matmul, _t(x), _t(y), name="bmm")
+    return apply(lambda a, b: jnp.matmul(a, b, precision=_matmul_precision()),
+                 _t(x), _t(y), name="bmm")
 
 
 def dot(x, y, name=None):
@@ -96,7 +98,8 @@ def dot(x, y, name=None):
 
 
 def inner(x, y, name=None):
-    return apply(jnp.inner, _t(x), _t(y), name="inner")
+    return apply(lambda a, b: jnp.inner(a, b, precision=_matmul_precision()),
+                 _t(x), _t(y), name="inner")
 
 
 def outer(x, y, name=None):

@@ -1,0 +1,108 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_to_static_backward_uses_current_rng_key():
+    """The cached compiled backward must rematerialize the forward with the
+    CURRENT call's RNG key — dropout grads must match the mask actually
+    sampled in that step's forward, not step 1's."""
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            # keep fc in the graph so the training path is taken, but make
+            # the output depend on x only through the dropout mask
+            return F.dropout(x, p=0.5) + 0.0 * self.fc(x).sum()
+
+    model = M()
+    model = paddle.jit.to_static(model)
+
+    for step in range(3):
+        x = paddle.to_tensor(np.full((16, 4), 2.0, np.float32),
+                             stop_gradient=False)
+        y = model(x)
+        mask_scale = y.numpy() / 2.0  # 0 or 1/(1-p) per element
+        y.backward(paddle.to_tensor(np.ones((16, 4), np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), mask_scale, rtol=1e-5,
+                                   err_msg=f"step {step}: backward used a "
+                                           "stale dropout mask")
+
+
+def test_grad_scaler_no_double_unscale():
+    model = paddle.nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    loss = model(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g = model.weight.grad.numpy().copy()
+    # documented pattern: unscale_ -> clip -> step must not re-divide
+    scaler.step(opt)
+    np.testing.assert_allclose(g, model.weight.grad.numpy(), rtol=1e-6)
+
+    # explicit double unscale_ raises (reference parity)
+    loss = model(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    with pytest.raises(RuntimeError):
+        scaler.unscale_(opt)
+
+
+def test_weighted_cross_entropy_mean_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    logits = rng.randn(8, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (8,)).astype(np.int64)
+    weight = rng.rand(5).astype(np.float32) + 0.1
+
+    ours = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           weight=paddle.to_tensor(weight), reduction="mean")
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels), weight=torch.tensor(weight))
+    np.testing.assert_allclose(ours.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_rng_stream_id_deterministic():
+    from paddle_tpu.core.random import _stream_id
+    expected = (int.from_bytes(
+        hashlib.sha256(b"global_seed").digest()[:4], "little") & 0x7FFFFFFF)
+    assert _stream_id("global_seed") == (expected or 1)
+
+
+def test_state_dict_filters_sublayer_non_persistable_buffers():
+    class Sub(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("scratch", paddle.to_tensor([1.0]),
+                                 persistable=False)
+            self.register_buffer("kept", paddle.to_tensor([2.0]))
+
+    class Root(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sub = Sub()
+
+    sd = Root().state_dict()
+    assert "sub.kept" in sd
+    assert "sub.scratch" not in sd
+
+
+def test_linear_matmul_precision_flag():
+    """f32 linear runs at full precision by default (tpu_matmul_precision)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 64).astype(np.float32)
+    w = rng.randn(64, 16).astype(np.float32)
+    out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(), x @ w, rtol=1e-5, atol=1e-5)
