@@ -94,9 +94,24 @@ def make_rng(name: Optional[str] = None):
         key = jax.random.fold_in(key, _tls.trace_count)
     else:
         key = _default_generator.next_key()
+    if name is None:
+        name = getattr(_tls, "stream_name", None)  # active stream_scope
     if name is not None:
         key = jax.random.fold_in(key, _stream_id(name))
     return key
+
+
+@contextlib.contextmanager
+def stream_scope(name: Optional[str]):
+    """Route unnamed make_rng draws to a named stream for this scope (used
+    by the TP RNGStatesTracker so dropout inside model-parallel regions
+    draws from the per-rank 'local_seed' stream)."""
+    prev = getattr(_tls, "stream_name", None)
+    _tls.stream_name = name
+    try:
+        yield
+    finally:
+        _tls.stream_name = prev
 
 
 _STREAMS = {}

@@ -7,6 +7,28 @@ meta-optimizer program rewrites→sharding specs + function transforms.
 """
 
 from . import env  # noqa: F401
+from . import collective  # noqa: F401
+from . import spmd  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_reduce_arrays,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    new_group,
+    ppermute_shift,
+    recv,
+    reduce,
+    scatter,
+    send,
+    wait,
+)
 from .parallel import (  # noqa: F401
     DataParallel,
     ParallelEnv,
@@ -14,25 +36,18 @@ from .parallel import (  # noqa: F401
     get_world_size,
     init_parallel_env,
 )
+from .spmd import apply_param_shardings, make_mesh, shard_map  # noqa: F401
 
 
 def __getattr__(name):
-    # lazy imports to avoid heavy costs / cycles at package import
-    if name in ("all_reduce", "all_gather", "broadcast", "reduce", "scatter",
-                "alltoall", "send", "recv", "barrier", "new_group", "wait",
-                "ReduceOp", "split", "all_reduce_arrays"):
-        from . import collective
-        return getattr(collective, name)
-    if name == "fleet":
-        from . import fleet
-        return fleet
-    if name == "meta_parallel":
-        from . import meta_parallel
-        return meta_parallel
+    # submodules imported lazily (they pull in engines/launchers)
+    import importlib
+    if name in ("fleet", "meta_parallel", "launch"):
+        return importlib.import_module(f".{name}", __name__)
     if name == "spawn":
-        from .spawn_mod import spawn
-        return spawn
-    if name == "launch":
-        from . import launch
-        return launch
-    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+        return importlib.import_module(".spawn_mod", __name__).spawn
+    if name == "split":
+        from .meta_parallel.parallel_layers.mp_layers import split
+        return split
+    raise AttributeError(
+        f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -58,3 +58,33 @@ def set_data_axis(name: Optional[str]):
 
 def current_data_axis() -> Optional[str]:
     return _global["data_axis"]
+
+
+# ---------------------------------------------------------------------------
+# Bound-axis tracking: collectives consult this to decide traced vs eager.
+# The analogue of the reference's "which ring am I on" (ring_id attr on
+# c_* ops) — here, which mesh axes the enclosing shard_map bound.
+# ---------------------------------------------------------------------------
+
+import contextlib
+
+
+def _axis_stack():
+    if not hasattr(_state, "axes"):
+        _state.axes = []
+    return _state.axes
+
+
+@contextlib.contextmanager
+def axes_bound(*names: str):
+    """Mark mesh axes as bound for the dynamic extent (used by shard_ctx)."""
+    stack = _axis_stack()
+    stack.extend(names)
+    try:
+        yield
+    finally:
+        del stack[len(stack) - len(names):]
+
+
+def bound_axes():
+    return tuple(_axis_stack())

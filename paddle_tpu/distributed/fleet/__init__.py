@@ -1,0 +1,125 @@
+"""fleet: distributed training facade.
+
+reference: python/paddle/distributed/fleet/base/fleet_base.py:103-1605 —
+`fleet.init` boots role maker + hybrid topology, `distributed_model` wraps
+the model per parallel mode, `distributed_optimizer` wraps the optimizer
+with the meta-optimizer chain (strategy_compiler.py:213).
+
+TPU-native: init builds the device mesh (HybridCommunicateGroup);
+distributed_model returns the matching meta_parallel engine (DataParallel /
+TensorParallel / PipelineParallel) whose train path is one SPMD jit over the
+mesh; meta-optimizer graph rewrites become sharding specs + transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "CommunicateTopology", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer",
+           "worker_index", "worker_num", "is_first_worker",
+           "barrier_worker", "init_is_called"]
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """Initialize the distributed context (reference: fleet_base.py:170).
+
+    Builds the hybrid mesh from strategy.hybrid_configs; with no strategy a
+    pure data-parallel mesh over all devices.
+    """
+    import jax
+
+    from ..parallel import init_parallel_env
+    init_parallel_env()
+
+    if strategy is None:
+        strategy = DistributedStrategy()
+    cfg = strategy.hybrid_configs
+    n_dev = len(jax.devices())
+    mp = int(cfg.get("mp_degree", 1))
+    pp = int(cfg.get("pp_degree", 1))
+    sh = int(cfg.get("sharding_degree", 1))
+    sp = int(cfg.get("sp_degree", 1))
+    dp = int(cfg.get("dp_degree", 0)) or max(1, n_dev // (mp * pp * sh * sp))
+
+    hcg = HybridCommunicateGroup(
+        dp_degree=dp, mp_degree=mp, pp_degree=pp,
+        sharding_degree=sh, sp_degree=sp)
+    set_hybrid_communicate_group(hcg)
+
+    # TP-safe RNG: the 'local_seed' stream folds in the mp rank so dropout
+    # masks differ across tensor-parallel shards while 'global_seed' agrees
+    # (reference: fleet/meta_parallel/parallel_layers/random.py:32).
+    from ...core.random import register_rng_stream
+    register_rng_stream("local_seed", 1000 + hcg.get_model_parallel_rank())
+
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+    return
+
+
+def init_is_called() -> bool:
+    return _fleet_state["initialized"]
+
+
+def _strategy() -> DistributedStrategy:
+    if _fleet_state["strategy"] is None:
+        _fleet_state["strategy"] = DistributedStrategy()
+    return _fleet_state["strategy"]
+
+
+def worker_index() -> int:
+    return env.get_rank()
+
+
+def worker_num() -> int:
+    return env.get_world_size()
+
+
+def is_first_worker() -> bool:
+    return env.get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """Wrap a Layer for the active parallel mode
+    (reference: fleet_base.py:883 — PipelineParallel / TensorParallel /
+    ShardingParallel / DataParallel)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        init()
+        hcg = get_hybrid_communicate_group()
+    mode = hcg.get_parallel_mode()
+    from .. import meta_parallel
+    if mode == "pipeline":
+        return meta_parallel.PipelineParallel(model, hcg, _strategy())
+    if mode == "model":
+        return meta_parallel.TensorParallel(model, hcg, _strategy())
+    if mode == "sharding":
+        return meta_parallel.ShardingParallel(model, hcg, _strategy())
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """reference: fleet_base.py:830 — meta-optimizer chain; TPU-native: the
+    optimizer is returned with the hybrid context attached (grad clip psums
+    over mp/pp groups are wired by the meta_parallel engines)."""
+    if strategy is not None:
+        _fleet_state["strategy"] = strategy
+    optimizer._hybrid_context = get_hybrid_communicate_group()
+    return optimizer

@@ -1,0 +1,97 @@
+"""DistributedStrategy: one typed config for every parallelism feature.
+
+reference: python/paddle/distributed/fleet/base/distributed_strategy.py
+backed by framework/distributed_strategy.proto:176-243. Here a plain python
+config object (no proto) with the same feature axes; meta-optimizer program
+rewrites become sharding specs + function transforms (SURVEY.md §7), so most
+knobs configure those transforms.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+_DEFAULTS: Dict[str, Any] = {
+    # hybrid parallelism degrees (reference: hybrid_configs → topology.py:36)
+    "hybrid_configs": {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sp_degree": 1,
+    },
+    # AMP (reference: distributed_strategy.proto amp_configs)
+    "amp": False,
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.5,
+        "use_pure_fp16": False,
+        "use_bf16": True,  # TPU-native default
+        "custom_white_list": [],
+        "custom_black_list": [],
+    },
+    # recompute (reference: recompute_configs)
+    "recompute": False,
+    "recompute_configs": {"checkpoints": []},
+    # ZeRO-style sharding (reference: sharding_configs)
+    "sharding": False,
+    "sharding_configs": {"stage": 1, "sharding_degree": 1},
+    # pipeline (reference: pipeline_configs)
+    "pipeline": False,
+    "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1,
+                         "schedule_mode": "1F1B"},
+    # tensor parallel (reference: tensor_parallel_configs)
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    # gradient merge / accumulation
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    # misc knobs kept for parity
+    "find_unused_parameters": False,
+    "fuse_all_reduce_ops": True,       # XLA fuses; parity no-op
+    "fuse_grad_size_in_MB": 32,        # parity no-op
+    "nccl_comm_num": 1,                # parity no-op
+    "localsgd": False,
+    "dgc": False,
+    "lamb": False,
+    "lars": False,
+    "a_sync": False,
+}
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py DistributedStrategy —
+    property per proto field; here attributes over a defaults dict."""
+
+    def __init__(self):
+        self.__dict__["_config"] = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        cfg = self.__dict__["_config"]
+        if name in cfg:
+            return cfg[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        cfg = self.__dict__["_config"]
+        if name not in cfg:
+            raise AttributeError(f"DistributedStrategy has no field {name!r}")
+        if isinstance(cfg[name], dict) and isinstance(value, dict):
+            cfg[name].update(value)
+        else:
+            cfg[name] = value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self.__dict__["_config"])
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.__dict__["_config"].items():
+            lines.append(f"  {k}={v!r},")
+        lines.append(")")
+        return "\n".join(lines)

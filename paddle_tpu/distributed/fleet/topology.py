@@ -1,0 +1,247 @@
+"""Hybrid-parallel topology: rank mesh → jax.sharding.Mesh + per-axis groups.
+
+reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology (:36) models the cartesian rank mesh over axes
+[data, pipe, sharding, model]; HybridCommunicateGroup (:117) builds one NCCL
+comm group per axis (_set_comm_group:193) plus p2p next/prev pipe groups
+(:225).
+
+TPU-native: the rank mesh IS a `jax.sharding.Mesh` over real devices; a
+"comm group" is just a named axis (no comm-id bootstrap). Axis order places
+mp (then sp) most-minor so tensor-parallel collectives ride adjacent ICI
+links, dp outermost so data-parallel allreduce crosses the slowest links
+(SURVEY.md §7 design mapping; scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import env
+from ..collective import Group, new_group
+from ..spmd import make_mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+
+# mesh axis order: outermost → innermost
+_AXIS_ORDER = ("dp", "pp", "sharding", "sp", "mp")
+
+
+class CommunicateTopology:
+    """Cartesian rank-coordinate math (reference: topology.py:36)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = _AXIS_ORDER,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world = int(np.prod(self._dims))
+        coords = list(itertools.product(*(range(d) for d in self._dims)))
+        self._coord_of_rank = {r: c for r, c in enumerate(coords)}
+        self._rank_of_coord = {c: r for r, c in enumerate(coords)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **axis_coords) -> int:
+        coord = tuple(axis_coords[n] for n in self._parallel_names)
+        return self._rank_of_coord[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._coord_of_rank[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        ax = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coord_of_rank.items()
+                      if c[ax] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along `axis_name` (reference:
+        topology.py get_comm_list — one group per combination of the other
+        axes' coordinates)."""
+        ax = self._parallel_names.index(axis_name)
+        others = [n for i, n in enumerate(self._parallel_names) if i != ax]
+        groups = []
+        for combo in itertools.product(
+                *(range(self._dims[i]) for i in range(len(self._dims))
+                  if i != ax)):
+            ranks = []
+            for k in range(self._dims[ax]):
+                coord = list(combo)
+                coord.insert(ax, k)
+                ranks.append(self._rank_of_coord[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Builds THE global device mesh + per-axis Group handles.
+
+    reference: topology.py:117 — _set_comm_group per axis via new_group +
+    NCCL init; here the mesh is built once and each axis becomes a Group
+    carrying the axis name (collectives key on it inside shard_map).
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+                 sharding_degree: int = 1, sp_degree: int = 1,
+                 devices=None):
+        if topology is not None:
+            dims = {n: topology.get_dim(n) for n in
+                    topology.get_hybrid_group_names()}
+            dp_degree = dims.get("dp", 1)
+            pp_degree = dims.get("pp", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sp_degree = dims.get("sp", 1)
+            mp_degree = dims.get("mp", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sp_degree = sp_degree
+
+        self._topo = CommunicateTopology(
+            _AXIS_ORDER,
+            (dp_degree, pp_degree, sharding_degree, sp_degree, mp_degree))
+        self.nranks = self._topo.world_size()
+
+        devices = list(devices if devices is not None else jax.devices())
+        if self.nranks > len(devices):
+            raise ValueError(
+                f"hybrid topology dp{dp_degree}×pp{pp_degree}×"
+                f"sharding{sharding_degree}×sp{sp_degree}×mp{mp_degree} "
+                f"needs {self.nranks} devices, have {len(devices)}")
+        self.mesh: Mesh = make_mesh(
+            {"dp": dp_degree, "pp": pp_degree, "sharding": sharding_degree,
+             "sp": sp_degree, "mp": mp_degree}, devices=devices)
+        env.set_mesh(self.mesh)
+
+        # this process's position (single-controller: rank 0's row; in
+        # multi-process SPMD each process computes its own)
+        self.global_rank = env.get_rank() % self.nranks
+
+        self._groups: Dict[str, Group] = {}
+        for ax in _AXIS_ORDER:
+            coord = self._topo.get_coord(self.global_rank)
+            idx = dict(zip(_AXIS_ORDER, coord))
+            ranks = self._topo.get_comm_list(ax)[0]
+            # group containing this rank along `ax`
+            for grp in self._topo.get_comm_list(ax):
+                if self.global_rank in grp:
+                    ranks = grp
+                    break
+            self._groups[ax] = Group(
+                ranks, gid=-1 - _AXIS_ORDER.index(ax), axis_name=ax)
+
+    # -- parity accessors (reference: topology.py:117-291) ------------------
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def _axis_rank(self, ax: str) -> int:
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[_AXIS_ORDER.index(ax)]
+
+    # data parallel
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("dp")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._groups["dp"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("mp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._groups["mp"].ranks[0]
+
+    # pipeline
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_p2p_groups(self):
+        """Pipeline P2P = ppermute shifts on the pp axis; the Group itself
+        is the channel (reference builds next/prev NCCL pairs, :225)."""
+        return (self._groups["pp"], self._groups["pp"])
+
+    # sharding (ZeRO)
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._groups["sharding"].ranks[0]
+
+    # sequence parallel (TPU-first addition; absent in reference — SURVEY §5)
+    def get_sequence_parallel_rank(self) -> int:
+        return self._axis_rank("sp")
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self._sp_degree
+
+    def get_sequence_parallel_group(self) -> Group:
+        return self._groups["sp"]
+
+    # check parallel mode (reference: _check_vaild_topo / get_parallel_mode)
+    def get_parallel_mode(self) -> str:
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "model"
+        if self._dp_degree > 1:
+            return "data"
+        return "single"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

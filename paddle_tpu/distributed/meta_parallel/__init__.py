@@ -1,0 +1,29 @@
+"""meta_parallel: hybrid-parallel model engines.
+
+reference: python/paddle/distributed/fleet/meta_parallel/ —
+TensorParallel (tensor_parallel.py:25), PipelineParallel
+(pipeline_parallel.py:80, 1F1B), ShardingParallel, and parallel_layers/
+(mp_layers.py TP building blocks, pp_layers.py PipelineLayer, random.py
+RNG tracker).
+
+TPU-native: engines don't rewrite graphs or drive NCCL — they attach
+PartitionSpecs and wrap the train step in one SPMD jit over the fleet mesh.
+"""
+
+from .parallel_base import ShardingParallel, TensorParallel  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("parallel_layers",):
+        return importlib.import_module(f".{name}", __name__)
+    if name in ("PipelineParallel", "PipelineLayer", "LayerDesc",
+                "SharedLayerDesc"):
+        mod = importlib.import_module(".pipeline_parallel", __name__)
+        return getattr(mod, name)
+    if name in ("VocabParallelEmbedding", "ColumnParallelLinear",
+                "RowParallelLinear", "ParallelCrossEntropy"):
+        mod = importlib.import_module(".parallel_layers.mp_layers", __name__)
+        return getattr(mod, name)
+    raise AttributeError(
+        f"module 'paddle_tpu.distributed.meta_parallel' has no attribute {name!r}")

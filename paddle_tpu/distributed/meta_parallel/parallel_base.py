@@ -1,0 +1,66 @@
+"""TensorParallel / ShardingParallel engine wrappers.
+
+reference: fleet/meta_parallel/tensor_parallel.py:25 (broadcast params +
+grads sync across mp group) and sharding_parallel.py. In the SPMD design
+parameter placement is declarative: the wrapper stamps each Parameter's
+PartitionSpec (``Parameter.spec``) and the jitted TrainStep lays arrays out
+with `jax.device_put`; XLA inserts the collectives — no broadcast/Reducer.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare()
+
+    def _prepare(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Marks mp-sharded params; everything else is replicated.
+
+    The mp_layers (ColumnParallelLinear etc.) stamp their own specs at
+    construction; this engine fills in `spec=None → replicated` and is the
+    place grad-clip norm reduction over the mp group is attached.
+    """
+
+    def _prepare(self):
+        for _, p in self._layers.named_parameters():
+            if getattr(p, "spec", None) is None:
+                p.spec = P()  # replicated
+
+
+class ShardingParallel(_MetaParallelBase):
+    """ZeRO-style: optimizer state sharded over the 'sharding' axis; param
+    specs stay replicated (stage 1/2). The actual opt-state PartitionSpecs
+    are applied by TrainStep (jit/to_static.py) reading hcg."""
+
+    def _prepare(self):
+        for _, p in self._layers.named_parameters():
+            if getattr(p, "spec", None) is None:
+                p.spec = P()

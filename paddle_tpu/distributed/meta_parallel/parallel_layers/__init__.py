@@ -1,0 +1,14 @@
+"""parallel_layers: TP building blocks + pipeline containers + RNG tracker.
+
+reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+(mp_layers.py, pp_layers.py, random.py).
+"""
+
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    split,
+)
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

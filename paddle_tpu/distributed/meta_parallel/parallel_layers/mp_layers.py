@@ -1,0 +1,188 @@
+"""Tensor-parallel layers: vocab/column/row-sharded modules.
+
+reference: fleet/meta_parallel/parallel_layers/mp_layers.py —
+VocabParallelEmbedding(:30) masks out-of-shard ids, looks up the local
+vocab slice and c_allreduce_sums; ColumnParallelLinear(:97) holds the
+out-dim shard with optional c_concat gather; RowParallelLinear(:170) holds
+the in-dim shard and c_allreduce_sums partial products;
+ParallelCrossEntropy(:249) is the vocab-parallel softmax CE
+(c_softmax_with_cross_entropy_op.cu).
+
+TPU-native (GSPMD): layers hold the FULL logical parameter annotated with a
+`PartitionSpec` (`Parameter.spec`); under jit over a mesh the arrays are
+laid out by those specs and XLA's SPMD partitioner inserts the very same
+collectives the reference writes by hand (masked gather + psum for the
+embedding, psum for row-parallel matmul). `with_sharding_constraint` pins
+the activation layouts (gather_output / input_is_parallel semantics).
+Eagerly on one device the layers behave as their dense equivalents.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn.initializer import Normal, XavierUniform
+from ....nn.layer import Layer
+from ... import env
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "split"]
+
+MP_AXIS = "mp"
+
+
+def _mesh():
+    m = env.get_mesh()
+    if m is not None and MP_AXIS in m.axis_names:
+        return m
+    return None
+
+
+def _constrain(x, *spec):
+    """Pin a Tensor's layout inside jit (no-op without an mp mesh)."""
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    from ....core.tensor import apply
+    pad = len(x.shape) - len(spec)
+    full = tuple(spec) + (None,) * max(0, pad) if pad > 0 else tuple(spec)
+    sh = NamedSharding(mesh, P(*full))
+    return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), x,
+                 name="sharding_constraint")
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over the mp axis."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02))
+        self.weight.spec = P(MP_AXIS, None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        # output replicated over mp (the psum the reference writes by hand)
+        return _constrain(out, *([None] * len(out.shape)))
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUT dim sharded (weight [in, out~mp])."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.spec = P(None, MP_AXIS)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.spec = P(MP_AXIS)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(y, *([None] * len(y.shape)))
+        # keep the activation sharded on its last dim (reference: no c_concat)
+        return _constrain(y, *([None] * (len(y.shape) - 1) + [MP_AXIS]))
+
+
+class RowParallelLinear(Layer):
+    """Linear with the IN dim sharded (weight [in~mp, out]); partial products
+    are summed over mp — GSPMD inserts the psum the reference's
+    c_allreduce_sum does."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.spec = P(MP_AXIS, None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.spec = P()  # replicated — added after the psum
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, *([None] * (len(x.shape) - 1) + [MP_AXIS]))
+        y = F.linear(x, self.weight, None)
+        y = _constrain(y, *([None] * len(y.shape)))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross entropy.
+
+    reference: mp_layers.py:249 → c_softmax_with_cross_entropy_op.cu — the
+    max/sum reductions run across the vocab-sharded axis. Here the stable
+    composition's reductions are partitioned by GSPMD (logits arrive sharded
+    [..., V~mp] from a gather_output=False column layer)."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, logits, label):
+        from ....core.tensor import apply
+        import jax.numpy as jnp
+
+        def _ce(lg, lab):
+            lg32 = lg.astype(jnp.float32)
+            m = jnp.max(lg32, axis=-1, keepdims=True)
+            z = lg32 - jax.lax.stop_gradient(m)
+            lse = jnp.log(jnp.sum(jnp.exp(z), axis=-1))
+            ids = lab.astype(jnp.int32)
+            if ids.ndim == lg.ndim:
+                ids = jnp.squeeze(ids, -1)
+            tgt = jnp.take_along_axis(z, ids[..., None], axis=-1)[..., 0]
+            return (lse - tgt)[..., None]
+
+        return apply(_ce, logits, label, name="parallel_cross_entropy")
+
+
+def split(x, size, operation: str, axis: int = 0, gather_out: bool = True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: collective.py:1233 paddle.distributed.split — build a
+    sharded linear/embedding layer in one call."""
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

@@ -1,0 +1,65 @@
+"""TP-safe RNG stream tracker.
+
+reference: fleet/meta_parallel/parallel_layers/random.py:32 RNGStatesTracker —
+tracks named CUDA RNG states so dropout inside model-parallel regions uses a
+per-rank ('local_seed') stream while regions outside use a cross-rank
+identical ('global_seed') stream.
+
+TPU-native: streams are fold-in offsets on the trace key
+(core/random.py), so the tracker is a thin façade that registers offsets and
+scopes a stream name.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ....core import random as _random
+
+MODEL_PARALLEL_RNG = "local_seed"
+GLOBAL_RNG = "global_seed"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise ValueError(f"state {name!r} already exists")
+        self.states_[name] = int(seed)
+        _random.register_rng_stream(name, int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+        for name, seed in self.states_.items():
+            _random.register_rng_stream(name, int(seed))
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Scope subsequent make_rng draws to the named stream."""
+        with _random.stream_scope(name):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """reference: random.py:86 — derive per-rank local + shared global
+    streams from one base seed."""
+    from ...fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    global _tracker
+    _tracker = RNGStatesTracker()
+    _tracker.add(GLOBAL_RNG, seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024 + mp_rank)
+    _random.seed(seed)

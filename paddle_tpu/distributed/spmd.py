@@ -1,0 +1,81 @@
+"""SPMD plumbing: mesh construction + axis-aware shard_map.
+
+The TPU-native replacement for the reference's multi-process execution
+fabric: where the reference launches one process per device and wires NCCL
+rings (fleet/launch_utils.py, platform/nccl_helper.h), here a single
+controller lays a :class:`jax.sharding.Mesh` over the chips and jit-compiles
+SPMD programs; collectives inside are keyed by named mesh axes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import env
+
+__all__ = ["make_mesh", "shard_map", "named_sharding", "current_mesh",
+           "PartitionSpec", "apply_param_shardings"]
+
+PartitionSpec = P
+
+
+def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:
+    """Build a named mesh. Axis order = dict order; trailing axes are most
+    minor (place tp/sp last so their collectives ride adjacent ICI links —
+    see SURVEY.md §7 design mapping)."""
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(v) for v in axis_sizes.values())
+    n = int(np.prod(sizes))
+    devices = list(devices if devices is not None else jax.devices())
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(sizes)
+    mesh = Mesh(arr, names)
+    return mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return env.get_mesh()
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
+    """Lay a Layer's parameters out on the mesh per their PartitionSpecs.
+
+    The TPU-native replacement for the reference's parameter broadcast at
+    engine setup (fleet/utils/hybrid_parallel_util.py:103): instead of
+    broadcasting replicas over NCCL, each Parameter carries a
+    ``spec`` (PartitionSpec) and is device_put once; XLA keeps it resident
+    in the sharded layout from then on.
+    """
+    mesh = mesh or env.get_mesh()
+    if mesh is None:
+        raise ValueError("no active mesh; call fleet.init or pass mesh=")
+    for _, p in layer.named_parameters():
+        spec = getattr(p, "spec", None) or P()
+        p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    for _, b in layer.named_buffers():
+        b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
+    return layer
+
+
+def shard_map(body, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """jax.shard_map wrapper that records the mesh's axis names as *bound*
+    for the dynamic extent of the body trace, so paddle_tpu.distributed
+    collectives called inside dispatch to their lax (traced) lowering."""
+
+    def wrapped(*args):
+        with env.axes_bound(*mesh.axis_names):
+            return body(*args)
+
+    return jax.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)

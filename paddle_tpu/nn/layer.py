@@ -177,7 +177,8 @@ class Layer:
     def parameters(self, include_sublayers=True) -> List[Parameter]:
         return [p for _, p in self.named_parameters()]
 
-    def named_buffers(self, prefix="", include_sublayers=True
+    def named_buffers(self, prefix="", include_sublayers=True,
+                      include_non_persistable=True
                       ) -> Iterator[Tuple[str, Tensor]]:
         seen = set()
         for layer_name, layer in self.named_sublayers(prefix=prefix, include_self=True):
@@ -185,6 +186,9 @@ class Layer:
                 if b is None or id(b) in seen:
                     continue
                 seen.add(id(b))
+                if (not include_non_persistable
+                        and bname in layer._non_persistable_buffer_names):
+                    continue
                 yield (layer_name + ("." if layer_name else "") + bname, b)
 
     def buffers(self, include_sublayers=True) -> List[Tensor]:
@@ -266,18 +270,9 @@ class Layer:
         dest = destination if destination is not None else collections.OrderedDict()
         for name, p in self.named_parameters(prefix=structured_name_prefix):
             dest[name] = p
-        # Persistability is owned by the registering sublayer, so filter on
-        # each sublayer's own _non_persistable_buffer_names.
-        seen = set()
-        for layer_name, layer in self.named_sublayers(
-                prefix=structured_name_prefix, include_self=True):
-            for bname, b in layer._buffers.items():
-                if b is None or id(b) in seen:
-                    continue
-                seen.add(id(b))
-                if bname in layer._non_persistable_buffer_names:
-                    continue
-                dest[layer_name + ("." if layer_name else "") + bname] = b
+        for name, b in self.named_buffers(prefix=structured_name_prefix,
+                                          include_non_persistable=False):
+            dest[name] = b
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):

@@ -17,6 +17,11 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The TPU-tunnel site customization force-selects its platform via
+# jax.config (ignoring the JAX_PLATFORMS env var), so re-select CPU
+# explicitly — tests need the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # NOTE: this JAX build lowers f32 matmuls to bf16 passes by default
 # (TPU-style). Do NOT globally raise jax_default_matmul_precision here — on
 # this CPU backend non-default precision makes conv compiles ~10x slower.

@@ -75,10 +75,11 @@ def test_weighted_cross_entropy_mean_matches_torch():
 
 
 def test_rng_stream_id_deterministic():
+    # use a name no other test registers an explicit offset for
     from paddle_tpu.core.random import _stream_id
     expected = (int.from_bytes(
-        hashlib.sha256(b"global_seed").digest()[:4], "little") & 0x7FFFFFFF)
-    assert _stream_id("global_seed") == (expected or 1)
+        hashlib.sha256(b"regr_stream_check").digest()[:4], "little") & 0x7FFFFFFF)
+    assert _stream_id("regr_stream_check") == (expected or 1)
 
 
 def test_state_dict_filters_sublayer_non_persistable_buffers():
