@@ -200,24 +200,95 @@ class TrainStep:
     (Program + Executor): params/opt-state live as device arrays owned by
     this object; each step is a single compiled call with buffer donation.
 
+    SPMD: pass ``mesh`` (or have fleet.init set one) and a ``data_spec``
+    PartitionSpec for the batch; parameters are laid out per their
+    ``Parameter.spec`` annotations, optimizer slots inherit the param
+    sharding, and ``zero_axis`` additionally shards replicated slots over
+    that mesh axis — ZeRO-1 optimizer-state partitioning (reference:
+    fleet/meta_optimizers/sharding_optimizer.py:72; here a layout
+    declaration, the weight-update all-gather is inserted by XLA).
+
     `sync_to_layer()` writes values back into the Layer for checkpointing /
     eager inspection.
     """
 
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
-                 metrics_fn: Optional[Callable] = None, donate: bool = True):
+                 metrics_fn: Optional[Callable] = None, donate: bool = True,
+                 mesh=None, data_spec=None, zero_axis: Optional[str] = None):
+        from ..distributed import env as dist_env
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.metrics_fn = metrics_fn
+        self.mesh = mesh if mesh is not None else (
+            dist_env.get_mesh() if data_spec is not None or zero_axis else None)
+        self.data_spec = data_spec
+        self.zero_axis = zero_axis
+        if self.mesh is not None:
+            if dist_env.get_mesh() is None:
+                dist_env.set_mesh(self.mesh)
+            from ..distributed.spmd import apply_param_shardings
+            apply_param_shardings(layer, self.mesh)
         self.params = trainable_param_arrays(layer)
         self.frozen = {k: v for k, v in param_arrays(layer).items()
                        if k not in self.params}
         self.buffers = buffer_arrays(layer)
         self.opt_state = optimizer.init_state(self.params)
+        if self.mesh is not None:
+            self._layout_opt_state()
         self.step_count = 0
         self._jitted: Dict[Any, Callable] = {}
         self._donate = donate
+
+    # -- SPMD layout -------------------------------------------------------
+    def _param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        specs = {}
+        for k, p in self.layer.named_parameters():
+            if k in self.params:
+                specs[k] = getattr(p, "spec", None) or P()
+        return specs
+
+    def _slot_spec(self, k, shape):
+        """Optimizer-slot spec: param spec, plus ZeRO sharding of the first
+        free, divisible dim over ``zero_axis``."""
+        from jax.sharding import PartitionSpec as P
+        spec = tuple(self._specs.get(k, P()))
+        spec = spec + (None,) * (len(shape) - len(spec))
+        if self.zero_axis and self.zero_axis in self.mesh.axis_names:
+            z = self.mesh.shape[self.zero_axis]
+            for i, (s, d) in enumerate(zip(spec, shape)):
+                if s is None and d % z == 0 and d >= z:
+                    spec = spec[:i] + (self.zero_axis,) + spec[i + 1:]
+                    break
+        return P(*spec)
+
+    def _layout_opt_state(self):
+        from jax.sharding import NamedSharding
+
+        self._specs = self._param_specs()
+
+        def place(k, slot):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    a, NamedSharding(self.mesh, self._slot_spec(k, a.shape)))
+                if hasattr(a, "shape") and a.ndim > 0 else a, slot)
+
+        self.opt_state = {k: place(k, v) for k, v in self.opt_state.items()}
+
+    def _place_batch(self, raw):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self.mesh is None or self.data_spec is None:
+            return raw
+        spec = tuple(self.data_spec)
+
+        def put(a):
+            if not hasattr(a, "ndim"):
+                return a
+            s = spec[:a.ndim] + (None,) * max(0, a.ndim - len(spec))
+            return jax.device_put(a, NamedSharding(self.mesh, P(*s)))
+
+        return [put(a) for a in raw]
 
     def _make_step(self, treedef, training=True):
         layer, loss_fn, optimizer = self.layer, self.loss_fn, self.optimizer
@@ -245,6 +316,7 @@ class TrainStep:
 
     def __call__(self, *batch):
         raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        raw = self._place_batch(raw)
         flat, treedef = jax.tree_util.tree_flatten(raw)
         sig = (_sig_of(flat)[0], treedef)
         jitted = self._jitted.get(sig)

@@ -1,0 +1,19 @@
+"""Flagship model families (language models built on the parallel layers).
+
+reference parity: the reference ships vision models in-tree
+(python/paddle/vision/models) and its GPT/BERT/ERNIE families through the
+fleet meta_parallel layers (fleet/meta_parallel/parallel_layers/mp_layers.py);
+here the language models live in-tree as the flagship demonstration of the
+TP/DP/SP sharding stack.
+"""
+
+from .gpt import (GPTConfig, GPTModel, GPTForPretraining,
+                  GPTPretrainingCriterion, gpt_tiny, gpt2_small, gpt2_medium)
+from .bert import (BertConfig, BertModel, BertForMaskedLM, bert_tiny,
+                   bert_base)
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForPretraining", "GPTPretrainingCriterion",
+    "gpt_tiny", "gpt2_small", "gpt2_medium",
+    "BertConfig", "BertModel", "BertForMaskedLM", "bert_tiny", "bert_base",
+]

@@ -1,0 +1,354 @@
+"""GPT: decoder-only language model — the flagship of the parallel stack.
+
+reference parity: the reference trains GPT through
+fleet/meta_parallel/parallel_layers/mp_layers.py (VocabParallelEmbedding:30,
+ColumnParallelLinear:97, RowParallelLinear:170, ParallelCrossEntropy:249)
+plus the fused attention kernels (paddle/fluid/operators/fused/
+fused_attention_op.cu, fused_feedforward_op.cu), wiring NCCL allreduces by
+hand between the sharded matmuls.
+
+TPU-native design (GSPMD, single logical program):
+- Every parameter is the FULL logical array annotated with a PartitionSpec
+  on the ``mp`` mesh axis (QKV/MLP-in column-sharded, attn-out/MLP-out
+  row-sharded, vocab embedding row-sharded). Under jit over a mesh, XLA's
+  SPMD partitioner lays the weights out and inserts the same psums the
+  reference's c_allreduce_sum ops perform — no hand-written collectives.
+- QKV is ONE fused matmul ([E] x [E, 3·H·D]) for MXU utilisation; the
+  weight is stored [E, 3, H, D] so the mp sharding rides the head axis and
+  the reshape to per-head layout is communication-free.
+- Attention routes through ops.attention (Pallas flash kernel when
+  eligible, fused XLA softmax otherwise), causal.
+- The LM head ties the vocab-parallel embedding weight; logits stay
+  vocab-sharded into ParallelCrossEntropy (the c_softmax_with_cross_entropy
+  pattern) so the [B, S, V] logits tensor is never materialised replicated.
+- ``use_recompute`` wraps each block in jax.checkpoint (reference:
+  fleet/utils/recompute.py) to trade FLOPs for HBM.
+- ``sequence_parallel`` pins the residual stream's seq axis to the ``sp``
+  mesh axis so LayerNorm/dropout activations are sequence-sharded
+  (reference: sequence_parallel_utils.py scatter/gather pattern).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.flags import matmul_precision
+from ..core.tensor import apply
+from ..distributed import env as dist_env
+from ..distributed.meta_parallel.parallel_layers.mp_layers import (
+    VocabParallelEmbedding, ParallelCrossEntropy)
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Dropout, Embedding
+from ..nn.layers.norm import LayerNorm
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForPretraining",
+           "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium"]
+
+MP = "mp"
+SP = "sp"
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304           # padded to a multiple of 128 for the MXU
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: Optional[int] = None   # default 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    sequence_parallel: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _mesh():
+    return dist_env.get_mesh()
+
+
+def _constrain(x, *spec):
+    """Pin a Tensor's layout inside jit; no-op without a mesh or when the
+    mesh lacks the referenced axes."""
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+    clean = tuple(s if (s in names if isinstance(s, str) else True) else None
+                  for s in spec)
+    sh = NamedSharding(mesh, P(*clean))
+    return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), x,
+                 name="sharding_constraint")
+
+
+def _seq_spec(cfg) -> Optional[str]:
+    """Mesh axis for the sequence dim of the residual stream (or None)."""
+    if not cfg.sequence_parallel:
+        return None
+    mesh = _mesh()
+    if mesh is not None and SP in mesh.axis_names:
+        return SP
+    return None
+
+
+class GPTAttention(Layer):
+    """Causal self-attention with ONE fused QKV matmul, head-sharded over mp.
+
+    reference: fused_attention_op.cu computes qkv in one gemm then runs the
+    fmha kernel; mp_layers.py shards qkv column-wise + out row-wise. Here the
+    qkv weight is [E, 3, H, D] with spec P(None, None, 'mp', None): one
+    logical gemm, head axis sharded, zero-copy reshape to [B, S, H, D].
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+        self.cfg = cfg
+        self.num_heads, self.head_dim = H, D
+        init = Normal(0.0, cfg.initializer_range)
+        # scaled init for the residual-out projection (GPT-2 paper)
+        out_init = Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.qkv_weight = self.create_parameter((E, 3, H, D),
+                                                default_initializer=init)
+        self.qkv_weight.spec = P(None, None, MP, None)
+        self.qkv_bias = self.create_parameter((3, H, D), is_bias=True)
+        self.qkv_bias.spec = P(None, MP, None)
+        self.out_weight = self.create_parameter((H, D, E),
+                                                default_initializer=out_init)
+        self.out_weight.spec = P(MP, None, None)
+        self.out_bias = self.create_parameter((E,), is_bias=True)
+        self.out_bias.spec = P()
+
+    def forward(self, x, cache=None):
+        cfg = self.cfg
+        prec = matmul_precision()
+
+        def qkv_fn(h, w, b):
+            y = jnp.einsum("bse,ethd->bsthd", h, w, precision=prec) + b
+            return y
+
+        qkv = apply(qkv_fn, x, self.qkv_weight, self.qkv_bias, name="fused_qkv")
+        qkv = _constrain(qkv, "dp", None, None, MP, None)
+        from ..tensor.manipulation import split as tsplit, squeeze
+        q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
+
+        if cache is not None:
+            from ..tensor.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+
+        from ..ops.attention import scaled_dot_product_attention
+        out = scaled_dot_product_attention(
+            q, k, v, dropout_p=cfg.attention_dropout_prob,
+            is_causal=True, training=self.training)   # [B, S, H, D]
+        out = _constrain(out, "dp", None, MP, None)
+
+        def out_fn(o, w, b):
+            return jnp.einsum("bshd,hde->bse", o, w, precision=prec) + b
+
+        y = apply(out_fn, out, self.out_weight, self.out_bias, name="attn_out")
+        return (y, cache) if cache is not None else y
+
+
+class GPTMLP(Layer):
+    """FFN: column-sharded in-proj, gelu, row-sharded out-proj.
+
+    reference: fused_feedforward_op.cu; mp_layers.py Column+RowParallelLinear
+    pair. Full logical weights, specs on the ffn axis; XLA inserts the psum
+    after the second matmul."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        E, FF = cfg.hidden_size, cfg.ffn_size
+        init = Normal(0.0, cfg.initializer_range)
+        out_init = Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.w_in = self.create_parameter((E, FF), default_initializer=init)
+        self.w_in.spec = P(None, MP)
+        self.b_in = self.create_parameter((FF,), is_bias=True)
+        self.b_in.spec = P(MP)
+        self.w_out = self.create_parameter((FF, E), default_initializer=out_init)
+        self.w_out.spec = P(MP, None)
+        self.b_out = self.create_parameter((E,), is_bias=True)
+        self.b_out.spec = P()
+
+    def forward(self, x):
+        h = F.linear(x, self.w_in, self.b_in)
+        h = _constrain(h, "dp", None, MP)
+        h = F.gelu(h, approximate=True)
+        y = F.linear(h, self.w_out, None)
+        y = _constrain(y, "dp", None, None)
+        return y + self.b_out
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.dropout1 = Dropout(cfg.hidden_dropout_prob)
+        self.dropout2 = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        sp = _seq_spec(self.cfg)
+        if cache is None:
+            a = self.attn(self.ln1(x))
+        else:
+            a, cache = self.attn(self.ln1(x), cache)
+        x = x + self.dropout1(a)
+        if sp:
+            x = _constrain(x, "dp", sp, None)
+        x = x + self.dropout2(self.mlp(self.ln2(x)))
+        if sp:
+            x = _constrain(x, "dp", sp, None)
+        return x if cache is None else (x, cache)
+
+
+class GPTModel(Layer):
+    """Embeddings + N decoder blocks + final LN. Returns hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size)
+        # re-init with the model's initializer_range
+        self.word_embeddings.weight._data = Normal(0.0, cfg.initializer_range)(
+            (cfg.vocab_size, cfg.hidden_size), "float32")
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.position_embeddings.weight._data = Normal(
+            0.0, cfg.initializer_range)(
+            (cfg.max_position_embeddings, cfg.hidden_size), "float32")
+        self.embedding_dropout = Dropout(cfg.hidden_dropout_prob)
+        self.layers = LayerList([GPTDecoderLayer(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.final_norm = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        B, S = input_ids.shape
+        if position_ids is None:
+            from ..tensor.creation import arange
+            start = 0 if caches is None else caches[0][0].shape[1]
+            position_ids = arange(start, start + S, dtype="int32")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        x = self.embedding_dropout(x)
+        sp = _seq_spec(self.cfg)
+        if sp:
+            x = _constrain(x, "dp", sp, None)
+
+        new_caches = [] if caches is not None else None
+        for i, blk in enumerate(self.layers):
+            if caches is not None:
+                x, c = blk(x, caches[i])
+                new_caches.append(c)
+            elif self.cfg.use_recompute and self.training:
+                from ..distributed.fleet.utils import recompute
+                x = recompute(blk, x)
+            else:
+                x = blk(x)
+        x = self.final_norm(x)
+        return x if caches is None else (x, new_caches)
+
+
+def parallel_logits(hidden, embedding_weight):
+    """LM head: hidden @ W_vocab.T with the vocab axis kept mp-sharded.
+
+    reference: parallel_matmul in the reference GPT impls — a column-parallel
+    matmul against the tied embedding table followed by NO gather; the
+    vocab-sharded logits feed ParallelCrossEntropy."""
+    prec = matmul_precision()
+
+    def fn(h, w):
+        return jnp.einsum("bse,ve->bsv", h, w, precision=prec)
+
+    logits = apply(fn, hidden, embedding_weight, name="lm_logits")
+    return _constrain(logits, "dp", None, MP)
+
+
+class GPTPretrainingCriterion(Layer):
+    """Mean vocab-parallel CE over non-masked positions.
+
+    reference: c_softmax_with_cross_entropy_op.cu + the loss-mask mean."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        losses = self.ce(logits, labels)          # [B, S, 1]
+        from ..tensor.manipulation import squeeze
+        losses = squeeze(losses, -1)
+
+        def reduce_fn(ls, *mm):
+            ls = ls.astype(jnp.float32)
+            if mm:
+                m = mm[0].astype(jnp.float32)
+                return jnp.sum(ls * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return jnp.mean(ls)
+
+        args = [losses] + ([loss_mask] if loss_mask is not None else [])
+        return apply(reduce_fn, *args, name="masked_lm_mean")
+
+
+class GPTForPretraining(Layer):
+    """GPT with the tied vocab-parallel LM head."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        out = self.gpt(input_ids, position_ids, caches)
+        if caches is not None:
+            hidden, new_caches = out
+            return parallel_logits(hidden, self.gpt.word_embeddings.weight), \
+                new_caches
+        return parallel_logits(out, self.gpt.word_embeddings.weight)
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    """Test-size config (runs on CPU meshes in seconds)."""
+    d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             max_position_embeddings=128, hidden_dropout_prob=0.0,
+             attention_dropout_prob=0.0)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_small(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+             max_position_embeddings=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_medium(**kw) -> GPTConfig:
+    """GPT-2 345M — BASELINE.md config 4."""
+    d = dict(vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+             max_position_embeddings=1024)
+    d.update(kw)
+    return GPTConfig(**d)

@@ -324,10 +324,18 @@ def _clone_layer(layer):
 
     from ..initializer import Constant, XavierUniform
 
+    import jax.numpy as jnp
+
     new = copy.deepcopy(layer)
     xavier = XavierUniform()
     for name, p in new.named_parameters():
         if p.ndim >= 2:
             p._data = xavier(tuple(p.shape), p.dtype)
-        # 1-D params (biases, LN scales) keep their deterministic init values
+        else:
+            # deepcopy of an (immutable) jax.Array keeps the SAME buffer;
+            # re-materialise so clones never alias (buffer donation in
+            # TrainStep forbids the same buffer appearing twice)
+            p._data = jnp.array(p._data, copy=True)
+    for name, b in new.named_buffers():
+        b._data = jnp.array(b._data, copy=True)
     return new

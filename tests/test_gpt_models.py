@@ -1,0 +1,172 @@
+"""Flagship language-model tests: GPT TP parity on the 8-device mesh,
+train-step convergence, KV-cache decode, BERT MLM.
+
+Analogue of the reference's hybrid-parallel model tests
+(test_parallel_dygraph_dataparallel.py / hybrid_parallel_gpt tests):
+sharded runs must match a single-device gold model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.models import (BertForMaskedLM, GPTForPretraining,
+                               GPTPretrainingCriterion, bert_tiny, gpt_tiny)
+from paddle_tpu.optimizer import AdamW
+
+
+def _tiny():
+    return gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=64)
+
+
+@pytest.fixture
+def clean_mesh():
+    yield
+    dist_env.set_mesh(None)
+
+
+def test_gpt_forward_backward_eager():
+    cfg = _tiny()
+    m = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    labels = Tensor(rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    logits = m(ids)
+    assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+    loss = crit(logits, labels)
+    loss.backward()
+    g = m.gpt.word_embeddings.weight.grad
+    assert g is not None and np.isfinite(np.asarray(g._data)).all()
+    assert float(np.asarray(loss._data)) == pytest.approx(
+        np.log(cfg.vocab_size), rel=0.15)
+
+
+def test_gpt_tp_parity_vs_dense(clean_mesh):
+    """Sharded (dp=2, mp=4) logits == single-device dense logits."""
+    cfg = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                   max_position_embeddings=64)
+    m = GPTForPretraining(cfg)
+    rng = np.random.RandomState(1)
+    ids_np = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+
+    with paddle.no_grad():
+        gold = m(Tensor(ids_np)).numpy()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().mesh
+    dist.apply_param_shardings(m, mesh)
+
+    # qkv weight really is head-sharded over mp: H=4 heads split 4-ways
+    qkv = m.gpt.layers[0].attn.qkv_weight._data
+    shard_shapes = {s.data.shape for s in qkv.addressable_shards}
+    assert shard_shapes == {(32, 3, 1, 8)}
+
+    static = paddle.jit.to_static(m)
+    with paddle.no_grad():
+        out = static(Tensor(ids_np)).numpy()
+    np.testing.assert_allclose(out, gold, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_train_step_loss_decreases():
+    cfg = _tiny()
+    m = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = AdamW(learning_rate=1e-2)
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels)
+
+    step = TrainStep(m, loss_fn, opt)
+    rng = np.random.RandomState(2)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32))
+    labels = Tensor(rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32))
+    losses = [float(np.asarray(step(ids, labels)._data)) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_gpt_sharded_train_step_zero1(clean_mesh):
+    """Full SPMD train step over dp×mp with ZeRO slots sharded over dp."""
+    cfg = _tiny()
+    m = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = AdamW(learning_rate=1e-2)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().mesh
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels)
+
+    step = TrainStep(m, loss_fn, opt, mesh=mesh, data_spec=P("dp"),
+                     zero_axis="dp")
+
+    # ZeRO-1: adam slots for the (replicated-dim0) mlp w_in [32, 128~mp]
+    # get dim0 sharded over dp
+    key = [k for k in step.opt_state if "w_in" in k][0]
+    slot = step.opt_state[key][0]
+    assert {s.data.shape for s in slot.addressable_shards} == {(8, 64)}
+
+    rng = np.random.RandomState(3)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32))
+    labels = Tensor(rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32))
+    losses = [float(np.asarray(step(ids, labels)._data)) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_gpt_kv_cache_decode_matches_full():
+    cfg = _tiny()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(4)
+    ids_np = rng.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+    with paddle.no_grad():
+        full = m(Tensor(ids_np)).numpy()
+
+        # prefill on the first 4 tokens, then decode one token at a time
+        caches = [(Tensor(np.zeros((2, 0, cfg.num_heads, cfg.head_dim),
+                                   np.float32)),) * 2
+                  for _ in range(cfg.num_layers)]
+        caches = [tuple(c) for c in caches]
+        logits, caches = m(Tensor(ids_np[:, :4]), caches=caches)
+        np.testing.assert_allclose(logits.numpy(), full[:, :4], rtol=1e-4,
+                                   atol=1e-4)
+        for t in range(4, 8):
+            logits, caches = m(Tensor(ids_np[:, t:t + 1]), caches=caches)
+            np.testing.assert_allclose(logits.numpy()[:, 0], full[:, t],
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_bert_mlm_train_step():
+    cfg = bert_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    intermediate_size=64, max_position_embeddings=64)
+    m = BertForMaskedLM(cfg)
+    opt = AdamW(learning_rate=1e-2)
+
+    def loss_fn(layer, ids, pos, labels):
+        scores = layer(ids, masked_positions=pos)
+        return layer.loss(scores, labels)
+
+    step = TrainStep(m, loss_fn, opt)
+    rng = np.random.RandomState(5)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32))
+    pos = Tensor(rng.randint(0, 16, (4, 3)).astype(np.int32))
+    labels = Tensor(rng.randint(0, cfg.vocab_size, (4, 3)).astype(np.int32))
+    losses = [float(np.asarray(step(ids, pos, labels)._data))
+              for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.7, losses
