@@ -1,0 +1,200 @@
+"""End-of-round benchmark (driver contract).
+
+Measures BASELINE.md configs on the real chip and prints ONE JSON line to
+stdout with the headline metric:
+
+    BERT-base MLM training throughput, tokens/sec/chip (BASELINE config 3,
+    the north-star metric), on whatever single accelerator is visible.
+
+Diagnostics (LeNet eager step rate, ResNet-50 img/s, MFU breakdown) go to
+stderr so stdout stays a single JSON line.
+
+`vs_baseline`: the reference (lijiaqi0612/Paddle) publishes no in-repo
+numbers (BASELINE.md: "published": {}), so CUDA parity is proxied by model
+FLOPs utilization: strong fused-kernel CUDA BERT pretraining implementations
+sit at ~40% MFU. vs_baseline = our_MFU / 0.40 — >= 1.0 means we match or
+beat a well-tuned CUDA baseline chip-for-chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# Peak dense matmul FLOP/s per chip (bf16).  f32 params are fine: the
+# default matmul policy lowers f32 gemms to bf16 passes on TPU.
+PEAKS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e
+}
+CUDA_PARITY_MFU = 0.40
+
+
+def device_peak_flops() -> float:
+    import jax
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAKS.items():
+        if kind.startswith(k):
+            return v
+    log(f"unknown device kind {kind!r}; assuming 100 TFLOP/s")
+    return 100e12
+
+
+def bench_bert_mlm() -> dict:
+    """BERT-base MLM jitted train step; returns tokens/sec + MFU."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    from paddle_tpu.optimizer import AdamW
+
+    B, S, M = 16, 512, 76          # batch, seq, masked positions (15%)
+    cfg = BertConfig()             # base: L12 H768 A12 vocab 30528
+    paddle.seed(42)
+    model = BertForMaskedLM(cfg)
+
+    def loss_fn(layer, ids, pos, labels):
+        scores = layer(ids, masked_positions=pos)
+        return layer.loss(scores, labels)
+
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01)
+    step = TrainStep(model, loss_fn, opt)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    pos = np.stack([rng.choice(S, M, replace=False) for _ in range(B)]
+                   ).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, M)).astype(np.int32)
+
+    t0 = time.perf_counter()
+    loss = step(ids, pos, labels)
+    float(loss)                      # block: compile + first step
+    log(f"bert: compile+step1 {time.perf_counter() - t0:.1f}s "
+        f"loss={float(loss):.3f}")
+
+    for _ in range(3):               # warmup
+        loss = step(ids, pos, labels)
+    float(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, pos, labels)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tokens_per_sec = B * S / dt
+
+    # Training FLOPs/token ~= 6*P_matmul + 12*L*h*S (PaLM appendix B).
+    h, L = cfg.hidden_size, cfg.num_layers
+    p_block = L * (12 * h * h)                       # qkvo + 2 mlp mats
+    p_embed_head = cfg.vocab_size * h                # tied decoder gemm
+    flops_token = 6 * (p_block + p_embed_head * M / S) + 12 * L * h * S
+    mfu = tokens_per_sec * flops_token / device_peak_flops()
+    log(f"bert: {dt*1e3:.1f} ms/step  {tokens_per_sec:,.0f} tok/s  "
+        f"MFU={mfu:.3f}")
+    return {"tokens_per_sec": tokens_per_sec, "mfu": mfu,
+            "ms_per_step": dt * 1e3}
+
+
+def bench_lenet_eager() -> None:
+    """Config 1: LeNet eager (dygraph) step rate — diagnostic only."""
+    try:
+        import paddle_tpu as paddle
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.optimizer import Momentum
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        model = LeNet()
+        opt = Momentum(learning_rate=0.01, parameters=model.parameters())
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(64, 1, 28, 28))
+            .astype(np.float32))
+        y = paddle.to_tensor(np.zeros((64,), np.int64))
+
+        def one():
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        one()                                        # warm caches
+        t0 = time.perf_counter()
+        for _ in range(10):
+            loss = one()
+        float(loss)
+        log(f"lenet eager: {(time.perf_counter()-t0)/10*1e3:.1f} ms/step "
+            f"(B=64)")
+    except Exception as e:                            # diagnostics must not
+        log(f"lenet eager bench failed: {e!r}")       # sink the headline
+
+
+def bench_resnet50() -> None:
+    """Config 2: ResNet-50 jitted img/s — diagnostic only."""
+    try:
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.to_static import TrainStep
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.optimizer import Momentum
+        from paddle_tpu.vision.models import resnet50
+
+        B = 64
+        paddle.seed(0)
+        model = resnet50(num_classes=1000)
+
+        def loss_fn(layer, xb, yb):
+            return F.cross_entropy(layer(xb), yb)
+
+        opt = Momentum(learning_rate=0.1, parameters=model.parameters(),
+                       momentum=0.9, weight_decay=1e-4)
+        step = TrainStep(model, loss_fn, opt)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(B, 3, 224, 224)).astype(np.float32)
+        y = rng.integers(0, 1000, (B,)).astype(np.int64)
+
+        t0 = time.perf_counter()
+        float(step(x, y))
+        log(f"resnet50: compile+step1 {time.perf_counter()-t0:.1f}s")
+        for _ in range(3):
+            step(x, y)
+        float(step(x, y))
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        log(f"resnet50: {dt*1e3:.1f} ms/step  {B/dt:,.0f} img/s (B={B})")
+    except Exception as e:
+        log(f"resnet50 bench failed: {e!r}")
+
+
+def main() -> None:
+    import jax
+    log(f"devices: {jax.devices()}")
+    full = "--quick" not in sys.argv
+    if full:
+        bench_lenet_eager()
+        bench_resnet50()
+    r = bench_bert_mlm()
+    print(json.dumps({
+        "metric": "bert_base_mlm_tokens_per_sec_per_chip",
+        "value": round(r["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(r["mfu"] / CUDA_PARITY_MFU, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

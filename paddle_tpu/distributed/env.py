@@ -51,6 +51,13 @@ def is_initialized() -> bool:
     return _global["initialized"]
 
 
+def reset():
+    """Clear process-global distributed state (tests / re-init)."""
+    _global["mesh"] = None
+    _global["initialized"] = False
+    _global["data_axis"] = None
+
+
 def set_data_axis(name: Optional[str]):
     """Set while tracing inside shard_map so SyncBatchNorm etc. can pmean."""
     _global["data_axis"] = name

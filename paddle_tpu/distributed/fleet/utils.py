@@ -1,0 +1,117 @@
+"""Fleet utilities: activation recomputation.
+
+reference parity: python/paddle/distributed/fleet/utils/recompute.py
+(RecomputeFunction.forward/backward:63,182 — CUDA RNG-state stashing +
+re-forward under enable_grad). The TPU-native redesign is `jax.checkpoint`:
+under jit the XLA backward rematerializes the segment instead of saving
+activations; in eager the tape's VJP closure holds only the segment inputs.
+RNG consistency is free here — dropout keys are split at Python trace time
+(core/random.trace_rng), so the rematerialized forward replays the same
+keys without the reference's fork_rng dance.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ...core.tensor import Tensor, apply
+from ...nn.layer import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` with activation checkpointing.
+
+    ``function`` may be a Layer (its parameters join the gradient path) or
+    any callable over Tensors. Memory: the backward keeps only the segment
+    inputs + params and rematerializes intermediates (reference:
+    fleet/utils/recompute.py:63; here via jax.checkpoint, which also
+    applies inside a jitted TrainStep trace).
+    """
+    del use_reentrant, preserve_rng_state   # parity knobs; single behavior
+
+    # Gradients only flow through explicit apply() args, so parameters must
+    # be passed in — harvest them from the callable: the Layer itself, a
+    # bound method's Layer, and any Layer/Tensor captured in closure cells
+    # (the `recompute(lambda x: f(block(x)), x)` pattern).
+    layers = []
+    if isinstance(function, Layer):
+        layers.append(function)
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer) and self_obj not in layers:
+        layers.append(self_obj)
+    loose_tensors = []
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer) and v not in layers:
+            layers.append(v)
+        elif isinstance(v, Tensor) and not v.stop_gradient:
+            loose_tensors.append(v)
+
+    p_entries = []                       # (layer_idx, name, tensor)
+    for li, lyr in enumerate(layers):
+        for k, p in lyr.named_parameters():
+            p_entries.append((li, k, p))
+    p_tensors = [p for _, _, p in p_entries]
+    n_p = len(p_tensors)
+    n_loose = len(loose_tensors)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+
+    def pure(*raw):
+        import contextlib
+        from ...jit.functional import bind
+        per_layer = [dict() for _ in layers]
+        for (li, k, _), arr in zip(p_entries, raw[:n_p]):
+            per_layer[li][k] = arr
+        xs = list(args)
+        for i, arr in zip(tensor_idx, raw[n_p + n_loose:]):
+            xs[i] = Tensor(arr)
+        with contextlib.ExitStack() as stack:
+            for t, arr in zip(loose_tensors, raw[n_p:n_p + n_loose]):
+                saved = t._data
+                t._data = arr
+                stack.callback(lambda t=t, s=saved: setattr(t, "_data", s))
+            for lyr, p_arrays in zip(layers, per_layer):
+                stack.enter_context(bind(lyr, p_arrays, None))
+            out = (layers[0](*xs, **kwargs) if isinstance(function, Layer)
+                   else function(*xs, **kwargs))
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        flat = tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+        return flat if len(flat) > 1 else flat[0]
+
+    ck = jax.checkpoint(pure)
+    return apply(ck, *p_tensors, *loose_tensors, *tensor_args,
+                 name="recompute")
+
+
+def recompute_sequential(ctx: Any, functions, *args, **kwargs):
+    """Checkpoint a sequence of layers segment by segment (reference:
+    fleet/utils/recompute.py recompute_sequential — segments kwarg)."""
+    segments = int((ctx or {}).get("segments", 1)) if isinstance(ctx, dict) \
+        else int(getattr(ctx, "segments", 1) or 1)
+    funcs = list(functions)
+    if not funcs:
+        return args[0] if len(args) == 1 else args
+    seg_size = max(1, (len(funcs) + segments - 1) // segments)
+    out = args
+    for s in range(0, len(funcs), seg_size):
+        chunk = funcs[s:s + seg_size]
+
+        def seg(*xs, _chunk=tuple(chunk)):
+            cur = xs
+            for f in _chunk:
+                cur = f(*cur) if isinstance(cur, tuple) else f(cur)
+                cur = cur if isinstance(cur, tuple) else cur
+            return cur
+        out = recompute(seg, *(out if isinstance(out, tuple) else (out,)),
+                        **kwargs)
+    return out

@@ -42,17 +42,7 @@ def _mesh():
     return None
 
 
-def _constrain(x, *spec):
-    """Pin a Tensor's layout inside jit (no-op without an mp mesh)."""
-    mesh = _mesh()
-    if mesh is None:
-        return x
-    from ....core.tensor import apply
-    pad = len(x.shape) - len(spec)
-    full = tuple(spec) + (None,) * max(0, pad) if pad > 0 else tuple(spec)
-    sh = NamedSharding(mesh, P(*full))
-    return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), x,
-                 name="sharding_constraint")
+from ...spmd import constrain as _constrain  # shared layout-pin helper
 
 
 class VocabParallelEmbedding(Layer):

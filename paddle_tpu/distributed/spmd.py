@@ -19,9 +19,55 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import env
 
 __all__ = ["make_mesh", "shard_map", "named_sharding", "current_mesh",
-           "PartitionSpec", "apply_param_shardings"]
+           "PartitionSpec", "apply_param_shardings", "constrain", "BATCH",
+           "data_axes"]
 
 PartitionSpec = P
+
+# Sentinel for "the batch dimension": expands to every data-style mesh axis
+# present (dp and the ZeRO 'sharding' axis), matching the composite
+# P(('dp', 'sharding')) batch layout TrainStep uses for its data_spec.
+BATCH = "__batch__"
+_DATA_AXES = ("dp", "sharding")
+
+
+def data_axes(mesh: Mesh):
+    """The mesh axes the batch dim is sharded over (dp + ZeRO sharding)."""
+    return tuple(a for a in _DATA_AXES if a in mesh.axis_names)
+
+
+def constrain(x, *spec):
+    """with_sharding_constraint on a Tensor/array against the active mesh.
+
+    Axis names absent from the mesh degrade to None (replicated); the BATCH
+    sentinel expands to the composite data axes; trailing dims pad with
+    None. No-op without an active mesh — model code can sprinkle layout
+    pins unconditionally.
+    """
+    mesh = env.get_mesh()
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+
+    def clean_one(s):
+        if s == BATCH:
+            axes = data_axes(mesh)
+            return axes if axes else None
+        if isinstance(s, str):
+            return s if s in names else None
+        if isinstance(s, (tuple, list)):
+            kept = tuple(a for a in s if a in names)
+            return kept if kept else None
+        return s
+    clean = tuple(clean_one(s) for s in spec)
+    ndim = len(x.shape)
+    clean = clean[:ndim] + (None,) * max(0, ndim - len(clean))
+    sh = NamedSharding(mesh, P(*clean))
+    from ..core.tensor import Tensor, apply
+    if isinstance(x, Tensor):
+        return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), x,
+                     name="sharding_constraint")
+    return jax.lax.with_sharding_constraint(x, sh)
 
 
 def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:

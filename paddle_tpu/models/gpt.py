@@ -83,18 +83,9 @@ def _mesh():
     return dist_env.get_mesh()
 
 
-def _constrain(x, *spec):
-    """Pin a Tensor's layout inside jit; no-op without a mesh or when the
-    mesh lacks the referenced axes."""
-    mesh = _mesh()
-    if mesh is None:
-        return x
-    names = set(mesh.axis_names)
-    clean = tuple(s if (s in names if isinstance(s, str) else True) else None
-                  for s in spec)
-    sh = NamedSharding(mesh, P(*clean))
-    return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), x,
-                 name="sharding_constraint")
+# shared layout-pin helper; BATCH expands to the composite data axes
+# (('dp', 'sharding')) so activation pins agree with TrainStep's data_spec
+from ..distributed.spmd import BATCH, constrain as _constrain  # noqa: E402
 
 
 def _seq_spec(cfg) -> Optional[str]:
@@ -144,7 +135,7 @@ class GPTAttention(Layer):
             return y
 
         qkv = apply(qkv_fn, x, self.qkv_weight, self.qkv_bias, name="fused_qkv")
-        qkv = _constrain(qkv, "dp", None, None, MP, None)
+        qkv = _constrain(qkv, BATCH, None, None, MP, None)
         from ..tensor.manipulation import split as tsplit, squeeze
         q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
 
@@ -158,7 +149,7 @@ class GPTAttention(Layer):
         out = scaled_dot_product_attention(
             q, k, v, dropout_p=cfg.attention_dropout_prob,
             is_causal=True, training=self.training)   # [B, S, H, D]
-        out = _constrain(out, "dp", None, MP, None)
+        out = _constrain(out, BATCH, None, MP, None)
 
         def out_fn(o, w, b):
             return jnp.einsum("bshd,hde->bse", o, w, precision=prec) + b
@@ -190,10 +181,10 @@ class GPTMLP(Layer):
 
     def forward(self, x):
         h = F.linear(x, self.w_in, self.b_in)
-        h = _constrain(h, "dp", None, MP)
+        h = _constrain(h, BATCH, None, MP)
         h = F.gelu(h, approximate=True)
         y = F.linear(h, self.w_out, None)
-        y = _constrain(y, "dp", None, None)
+        y = _constrain(y, BATCH, None, None)
         return y + self.b_out
 
 
@@ -218,10 +209,10 @@ class GPTDecoderLayer(Layer):
             a, cache = self.attn(self.ln1(x), cache)
         x = x + self.dropout1(a)
         if sp:
-            x = _constrain(x, "dp", sp, None)
+            x = _constrain(x, BATCH, sp, None)
         x = x + self.dropout2(self.mlp(self.ln2(x)))
         if sp:
-            x = _constrain(x, "dp", sp, None)
+            x = _constrain(x, BATCH, sp, None)
         return x if cache is None else (x, cache)
 
 
@@ -257,7 +248,7 @@ class GPTModel(Layer):
         x = self.embedding_dropout(x)
         sp = _seq_spec(self.cfg)
         if sp:
-            x = _constrain(x, "dp", sp, None)
+            x = _constrain(x, BATCH, sp, None)
 
         new_caches = [] if caches is not None else None
         for i, blk in enumerate(self.layers):
@@ -285,7 +276,7 @@ def parallel_logits(hidden, embedding_weight):
         return jnp.einsum("bse,ve->bsv", h, w, precision=prec)
 
     logits = apply(fn, hidden, embedding_weight, name="lm_logits")
-    return _constrain(logits, "dp", None, MP)
+    return _constrain(logits, BATCH, None, MP)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -46,8 +46,13 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
 
 
 def _flash_supported(q, k, v, mask, dropout_p) -> bool:
-    if dropout_p > 0.0 or mask is not None:
+    if dropout_p > 0.0:
         return False
+    if mask is not None:
+        # only additive key-padding masks [B, 1, 1, Sk] fit the kernel
+        if (mask.dtype == jnp.bool_ or mask.ndim != 4
+                or mask.shape[1] != 1 or mask.shape[2] != 1):
+            return False
     B, S, H, D = q.shape
     Sk = k.shape[1]
     return (
@@ -63,10 +68,7 @@ def sdpa_array(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
     """Raw-array scaled dot-product attention with flash dispatch."""
     if use_flash and _flash_supported(q, k, v, mask, dropout_p):
         from .pallas.flash_attention import flash_attention
-        try:
-            return flash_attention(q, k, v, causal=is_causal)
-        except Exception:
-            pass
+        return flash_attention(q, k, v, bias=mask, causal=is_causal)
     return _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key)
 
 

@@ -39,4 +39,10 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(1234)
     np.random.seed(1234)
+    # Tests that fleet.init() / set_mesh() must not leak the global mesh into
+    # later tests (sharding constraints would bind to a stale 8-way mesh).
+    # Snapshot/restore keeps module-scoped mesh fixtures working.
+    from paddle_tpu.distributed import env as dist_env
+    snap = dict(dist_env._global)
     yield
+    dist_env._global.update(snap)

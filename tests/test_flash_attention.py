@@ -1,0 +1,165 @@
+"""Flash-attention kernel correctness vs the XLA sdpa composition.
+
+Analogue of the reference's fused-attention parity tests
+(reference: test_fused_attention_op.py — fused kernel vs the unfused
+composition within tolerance). Runs the same Pallas kernels through the
+interpreter on CPU; the TPU path compiles the identical kernel code.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention import _sdpa_xla
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+B, S, H, D = 2, 256, 2, 64
+
+
+def _qkv(seed=0, dtype=np.float32, s=S):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(B, s, H, D).astype(dtype) * 0.5  # noqa: E731
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+def _ref(q, k, v, mask=None, causal=False):
+    with jax.default_matmul_precision("highest"):
+        return _sdpa_xla(q, k, v, mask, 0.0, causal, None)
+
+
+def test_forward_matches_xla():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_forward_causal():
+    q, k, v = _qkv(1)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_forward_key_padding_bias():
+    q, k, v = _qkv(2)
+    keep = np.ones((B, 1, 1, S), np.float32)
+    keep[:, :, :, S // 2:] = 0.0          # mask out second half of keys
+    bias = (1.0 - keep) * -1e30
+    out = flash_attention(q, k, v, bias=jnp.asarray(bias))
+    ref = _ref(q, k, v, mask=jnp.asarray(bias))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_xla(causal):
+    q, k, v = _qkv(3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_grads_with_bias():
+    q, k, v = _qkv(4)
+    keep = np.ones((B, 1, 1, S), np.float32)
+    keep[:, :, :, -64:] = 0.0
+    bias = jnp.asarray((1.0 - keep) * -1e30)
+
+    g_flash = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, bias=bias) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(_ref(q, k, v, mask=bias) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4, err_msg=f"d{name}")
+
+
+def test_learned_bias_gradient():
+    # a trainable additive bias (ALiBi-style, finite values) must receive
+    # the true gradient on the flash path, matching the XLA composition
+    q, k, v = _qkv(10)
+    rng = np.random.RandomState(11)
+    bias = jnp.asarray(rng.randn(B, 1, 1, S).astype(np.float32))
+
+    db_flash = jax.grad(
+        lambda b_: jnp.sum(flash_attention(q, k, v, bias=b_) ** 2))(bias)
+    db_ref = jax.grad(
+        lambda b_: jnp.sum(_ref(q, k, v, mask=b_) ** 2))(bias)
+    assert float(jnp.max(jnp.abs(db_ref))) > 1e-3   # non-trivial gradient
+    np.testing.assert_allclose(np.asarray(db_flash), np.asarray(db_ref),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_rectangular_seq_lens():
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(B, 128, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, 384, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, 384, H, D).astype(np.float32))
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_rectangular_causal_bottom_right():
+    # chunked prefill: 128 new queries against a 384-long KV cache; causal
+    # alignment must be bottom-right (row i sees keys <= i + Sk - Sq)
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(B, 128, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, 384, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, 384, H, D).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True)
+    ref = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # and grads
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        _ref(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_non_dividing_seq_len_picks_smaller_block():
+    # S=768 does not divide the 512 default block; kernel must pick 384
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 768, 2, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 768, 2, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 768, 2, D).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True)
+    ref = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(6, dtype=np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out.astype(jnp.float32)),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_jit_and_under_trainstep_shapes():
+    q, k, v = _qkv(7)
+    jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    out = jitted(q, k, v)
+    assert out.shape == (B, S, H, D)

@@ -29,6 +29,8 @@ def mp_mesh():
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": N}
     fleet.init(is_collective=True, strategy=strategy)
     yield fleet.get_hybrid_communicate_group()
+    from paddle_tpu.distributed import env as dist_env
+    dist_env.reset()
 
 
 def _sharded_forward(layer, x_np):
