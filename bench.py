@@ -53,11 +53,16 @@ def device_peak_flops() -> float:
 def bench_bert_mlm() -> dict:
     """BERT-base MLM jitted train step; returns tokens/sec + MFU."""
     import paddle_tpu as paddle
+    # bf16 MXU passes with f32 accumulation — the production policy the
+    # MFU math (bf16 peak) assumes; the framework-wide default is
+    # "highest" (full f32) for numerics-sensitive eager work
+    paddle.set_flags({"tpu_matmul_precision": "default"})
     from paddle_tpu.jit.to_static import TrainStep
     from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
     from paddle_tpu.optimizer import AdamW
 
-    B, S, M = 16, 512, 76          # batch, seq, masked positions (15%)
+    B, S, M = 32, 512, 76          # batch, seq, masked positions (15%)
+    # (B=32 measured best on v5e: 64.6k tok/s vs 59.8k at B=16)
     cfg = BertConfig()             # base: L12 H768 A12 vocab 30528
     paddle.seed(42)
     model = BertForMaskedLM(cfg)
@@ -182,6 +187,10 @@ def bench_resnet50() -> None:
 
 def main() -> None:
     import jax
+
+    import paddle_tpu as paddle
+    # all benches measure the production policy: bf16 MXU, f32 accumulate
+    paddle.set_flags({"tpu_matmul_precision": "default"})
     log(f"devices: {jax.devices()}")
     full = "--quick" not in sys.argv
     if full:

@@ -65,12 +65,16 @@ from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import profiler as _profiler_mod  # noqa: F401
 from . import incubate  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import text  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
 from .autograd.functional import grad  # noqa: F401
+from .tensor.einsum import einsum  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 
 # `paddle.nn.functional` style import convenience

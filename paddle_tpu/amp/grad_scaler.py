@@ -32,6 +32,7 @@ class AmpScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled: set = set()  # ids of optimizers unscaled this step
+        self._stepped: set = set()   # ids of optimizers stepped this step
 
     def is_enable(self):
         return self._enable
@@ -73,21 +74,33 @@ class AmpScaler:
         self._found_inf = bool(bad > 0)
 
     def minimize(self, optimizer, scaled_loss):
+        """backward + step + scale update in one call (reference:
+        amp/grad_scaler.py:123 minimize — which DOES advance the scale,
+        unlike step())."""
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def step(self, optimizer):
+        """Unscale (if not already) and conditionally optimizer.step().
+        Does NOT advance the loss scale — call update() after, per the
+        reference pattern scale().backward(); step(opt); update()
+        (reference: amp/grad_scaler.py:159 — raises on double step)."""
         if not self._enable:
             optimizer.step()
             return
+        if id(optimizer) in self._stepped:
+            raise RuntimeError(
+                "step() has already been called since the last update().")
         if id(optimizer) not in self._unscaled:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._stepped.add(id(optimizer))
 
     def update(self):
         self._unscaled.clear()
+        self._stepped.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -93,6 +93,24 @@ def recompute(function, *args, use_reentrant: bool = True,
                  name="recompute")
 
 
+class _Segment(Layer):
+    """A chunk of layers/callables as ONE Layer, so recompute() harvests
+    the chunk's parameters into the gradient path."""
+
+    def __init__(self, fns):
+        super().__init__()
+        self._fns = list(fns)
+        for i, f in enumerate(self._fns):
+            if isinstance(f, Layer):
+                self.add_sublayer(str(i), f)
+
+    def forward(self, *xs):
+        cur = xs
+        for f in self._fns:
+            cur = f(*cur) if isinstance(cur, tuple) else f(cur)
+        return cur
+
+
 def recompute_sequential(ctx: Any, functions, *args, **kwargs):
     """Checkpoint a sequence of layers segment by segment (reference:
     fleet/utils/recompute.py recompute_sequential — segments kwarg)."""
@@ -104,14 +122,7 @@ def recompute_sequential(ctx: Any, functions, *args, **kwargs):
     seg_size = max(1, (len(funcs) + segments - 1) // segments)
     out = args
     for s in range(0, len(funcs), seg_size):
-        chunk = funcs[s:s + seg_size]
-
-        def seg(*xs, _chunk=tuple(chunk)):
-            cur = xs
-            for f in _chunk:
-                cur = f(*cur) if isinstance(cur, tuple) else f(cur)
-                cur = cur if isinstance(cur, tuple) else cur
-            return cur
+        seg = _Segment(funcs[s:s + seg_size])
         out = recompute(seg, *(out if isinstance(out, tuple) else (out,)),
                         **kwargs)
     return out

@@ -1,0 +1,115 @@
+"""Distributed launcher.
+
+reference parity: python/paddle/distributed/fleet/launch.py:451 (launch
+collective mode: one worker process per device, env-var protocol
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_MASTER, log redirection,
+failure propagation) and launch_utils.py (TrainerProc bookkeeping).
+
+TPU-native notes: on TPU pods the normal topology is ONE process per host
+(JAX SPMD controller per host), so --nproc_per_node defaults to 1 and
+--nnodes/--node_rank/--master describe the host fabric; the env protocol
+feeds `init_parallel_env` which calls jax.distributed.initialize. Local
+multi-process launches (CPU testing, one proc per chip debugging) use
+nproc_per_node > 1.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _build_env(rank: int, world: int, master: str, port: int,
+               local_rank: int, extra=None) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master,
+        "MASTER_PORT": str(port),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "FLAGS_selected_tpus": str(local_rank),
+    })
+    if extra:
+        env.update(extra)
+    return env
+
+
+def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
+           nnodes: int = 1, node_rank: int = 0,
+           master: Optional[str] = None, port: int = 12355,
+           log_dir: Optional[str] = None) -> int:
+    """Start nproc_per_node worker processes running ``script``; block until
+    all exit. Returns the first nonzero exit code (0 on success). On any
+    worker failure the remaining workers receive SIGTERM — the reference's
+    terminate_local_procs behavior (launch_utils.py)."""
+    master = master or "127.0.0.1"
+    world = nproc_per_node * nnodes
+    procs = []
+    logs = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for local_rank in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local_rank
+        env = _build_env(rank, world, master, port, local_rank)
+        if log_dir:
+            log_f = open(os.path.join(log_dir, f"workerlog.{local_rank}"),
+                         "w")
+            logs.append(log_f)
+            stdout = stderr = log_f
+        else:
+            stdout = stderr = None
+        cmd = [sys.executable, "-u", script, *script_args]
+        procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
+                                      stderr=stderr))
+
+    rc = 0
+    try:
+        alive = set(range(len(procs)))
+        while alive:
+            for i in list(alive):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                alive.discard(i)
+                if code != 0 and rc == 0:
+                    rc = code
+                    for j in alive:          # fail fast: stop the rest
+                        procs[j].send_signal(signal.SIGTERM)
+            time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training job "
+                    "(reference: fleet/launch.py)")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master", type=str, default=None,
+                        help="coordinator host (default 127.0.0.1)")
+    parser.add_argument("--port", type=int, default=12355)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.script, args.script_args,
+                  nproc_per_node=args.nproc_per_node, nnodes=args.nnodes,
+                  node_rank=args.node_rank, master=args.master,
+                  port=args.port, log_dir=args.log_dir)

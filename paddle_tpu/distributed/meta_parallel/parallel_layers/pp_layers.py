@@ -1,0 +1,211 @@
+"""Pipeline layer descriptions + stage segmentation.
+
+reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc(:31), SharedLayerDesc(:49), SegmentLayers(:63 uniform/param-count
+balancing), PipelineLayer(:132 builds only the local stage's layers).
+
+TPU-native difference: a single SPMD controller owns every stage, so
+PipelineLayer materializes ALL stages (each stage is an nn.Sequential) and
+the schedule (pipeline_parallel.py) walks them; placement over the 'pp'
+mesh axis is a layout concern, not a process-identity concern.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence
+
+from ....nn.layer import Layer, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction: class + ctor args, built per stage."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) and not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer subclass or callable")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        name = getattr(self.layer_func, "__name__", str(self.layer_func))
+        return f"LayerDesc({name})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared across stages (e.g. tied
+    embedding/LM-head). All descs with the same ``key`` resolve to ONE
+    built layer instance; ``forward_func`` customizes the call at reuse
+    sites (reference: pp_layers.py:49)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedCall(Layer):
+    """Call-site wrapper around a shared layer instance."""
+
+    def __init__(self, shared: Layer, forward_func: Optional[Callable]):
+        super().__init__()
+        # register as sublayer only at the FIRST site; later sites hold a
+        # plain reference so parameters are not double-counted
+        object.__setattr__(self, "_shared_ref", shared)
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared_ref, *args, **kwargs)
+        return self._shared_ref(*args, **kwargs)
+
+
+class SegmentLayers:
+    """Split a desc list into num_parts contiguous segments.
+
+    method="uniform": equal layer counts. method="layer:<Name>": one
+    boundary before each layer whose class name matches, mirroring the
+    reference's seg_method="layer:TransformerBlock" style.
+    """
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform"):
+        self.descs = list(layers_desc)
+        self.num_parts = int(num_parts)
+        self.method = method
+        if len(self.descs) < self.num_parts:
+            raise ValueError(
+                f"{len(self.descs)} layers cannot fill {num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        n, parts = len(self.descs), self.num_parts
+        if self.method == "uniform":
+            base, rem = divmod(n, parts)
+            bounds = [0]
+            for i in range(parts):
+                bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+            return bounds
+        m = re.match(r"layer:(.+)", self.method)
+        if m:
+            name = m.group(1)
+            marks = [i for i, d in enumerate(self.descs)
+                     if self._desc_name(d) == name]
+            if len(marks) < parts:
+                raise ValueError(
+                    f"only {len(marks)} '{name}' layers for {parts} stages")
+            # distribute the matched layers evenly; boundary = first matched
+            # layer of each chunk
+            bounds = [0]
+            base, rem = divmod(len(marks), parts)
+            idx = 0
+            for i in range(parts - 1):
+                idx += base + (1 if i < rem else 0)
+                bounds.append(marks[idx])
+            bounds.append(n)
+            return bounds
+        raise ValueError(f"unknown seg method {self.method!r}")
+
+    @staticmethod
+    def _desc_name(d) -> str:
+        if isinstance(d, LayerDesc):
+            return getattr(d.layer_func, "__name__", "")
+        return type(d).__name__
+
+
+class PipelineLayer(Layer):
+    """The whole network as an ordered desc list, segmented into stages.
+
+    Unlike the reference (which builds only the stage owned by this
+    process, pp_layers.py:132), every stage is materialized — the SPMD
+    controller drives all of them; `stage(i)` returns stage i's Sequential.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: int = 1,
+                 loss_fn=None, seg_method: str = "uniform", topology=None,
+                 recompute_interval: int = 0):
+        super().__init__()
+        self._descs = list(layers)
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._topology = topology
+        self._recompute_interval = recompute_interval
+        self._shared_instances = {}
+        self.segment_bounds = SegmentLayers(
+            self._descs, self._num_stages, seg_method).do_segment()
+        self._stages = []
+        for s in range(self._num_stages):
+            lo, hi = self.segment_bounds[s], self.segment_bounds[s + 1]
+            built = [self._build(d) for d in self._descs[lo:hi]]
+            stage = Sequential(*built)
+            self._stages.append(stage)
+            self.add_sublayer(f"stage_{s}", stage)
+
+    def _build(self, desc):
+        if isinstance(desc, SharedLayerDesc):
+            key = desc.layer_name
+            if key not in self._shared_instances:
+                inst = desc.build_layer()
+                self._shared_instances[key] = inst
+                wrapper = _SharedCall(inst, desc.forward_func)
+                # first site owns the params
+                wrapper.add_sublayer("shared", inst)
+                return wrapper
+            return _SharedCall(self._shared_instances[key],
+                               desc.forward_func)
+        if isinstance(desc, LayerDesc):
+            built = desc.build_layer()
+        elif isinstance(desc, Layer):
+            built = desc
+        elif callable(desc):
+            built = _FnLayer(desc)
+        else:
+            raise TypeError(f"cannot build pipeline layer from {desc!r}")
+        if self._recompute_interval:
+            from ...fleet.utils import recompute
+
+            class _Recomputed(Layer):
+                def __init__(self, inner):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, *a, **kw):
+                    return recompute(self.inner, *a, **kw)
+            return _Recomputed(built)
+        return built
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def stage(self, i: int) -> Sequential:
+        return self._stages[i]
+
+    def shared_layer(self, key: str) -> Layer:
+        return self._shared_instances[key]
+
+    def forward(self, x):
+        for s in self._stages:
+            x = s(x)
+        return x
+
+    def loss(self, output, labels):
+        if self._loss_fn is None:
+            raise ValueError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, labels)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

@@ -1,0 +1,63 @@
+"""paddle.distributed.spawn analogue.
+
+reference parity: python/paddle/distributed/spawn.py:568 — start nprocs
+worker processes running ``func(*args)`` with the trainer env protocol set,
+join and re-raise the first failure (_throw_exception_if_process_failed).
+
+Uses the 'spawn' start method so each worker gets a fresh JAX runtime
+(forking a process with an initialized TPU backend is unsafe).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Sequence
+
+__all__ = ["spawn", "SpawnContext"]
+
+
+def _worker(func, args, rank: int, nprocs: int, master: str, port: int):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master,
+        "MASTER_PORT": str(port),
+        "PADDLE_LOCAL_RANK": str(rank),
+    })
+    func(*args)
+
+
+class SpawnContext:
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        failed = [p for p in self.processes if p.exitcode not in (0, None)]
+        if failed:
+            codes = {p.pid: p.exitcode for p in failed}
+            for p in self.processes:        # stop stragglers, fail fast
+                if p.is_alive():
+                    p.terminate()
+            raise RuntimeError(f"spawned workers failed: {codes}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          master: str = "127.0.0.1", port: int = 12355, **options):
+    """Run ``func`` in nprocs fresh processes with the trainer env set."""
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, master, port))
+        p.daemon = options.get("daemon", False)
+        p.start()
+        procs.append(p)
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+    return context

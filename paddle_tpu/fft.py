@@ -1,0 +1,170 @@
+"""Discrete Fourier transforms.
+
+reference parity: python/paddle/fft.py (fft/ifft/rfft/irfft/hfft/ihfft +
+2d/nd variants, fftfreq/rfftfreq, fftshift/ifftshift; norm in
+{"backward", "ortho", "forward"}).
+
+TPU-native: thin tape-aware wrappers over jnp.fft — XLA lowers FFTs to the
+backend's native FFT ops, so there is nothing to hand-schedule. The `apply`
+wrapper keeps eager autograd working (jax.vjp of the fft primitives).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be one of {_NORMS}")
+    return norm
+
+
+def _wrap(fn, x, name, **kw):
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return apply(lambda a: fn(a, **kw), x, name=name)
+
+
+# -- 1d ---------------------------------------------------------------------
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.fft, x, "fft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.ifft, x, "ifft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.rfft, x, "rfft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.irfft, x, "irfft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.hfft, x, "hfft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(jnp.fft.ihfft, x, "ihfft", n=n, axis=axis,
+                 norm=_check_norm(norm))
+
+
+# -- 2d ---------------------------------------------------------------------
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(jnp.fft.fft2, x, "fft2", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(jnp.fft.ifft2, x, "ifft2", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(jnp.fft.rfft2, x, "rfft2", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(jnp.fft.irfft2, x, "irfft2", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+# -- nd ---------------------------------------------------------------------
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(jnp.fft.fftn, x, "fftn", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(jnp.fft.ifftn, x, "ifftn", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(jnp.fft.rfftn, x, "rfftn", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(jnp.fft.irfftn, x, "irfftn", s=s, axes=axes,
+                 norm=_check_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian-input nd FFT: forward FFT over the leading axes, then a
+    Hermitian (real-output) transform on the last axis — the inverse of
+    ihfftn (reference: fft.py:729)."""
+    def impl(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        lead_s = None if s is None else s[:-1]
+        inner = jnp.fft.fftn(a, s=lead_s, axes=ax[:-1], norm=norm)
+        n = None if s is None else s[-1]
+        return jnp.fft.hfft(inner, n=n, axis=ax[-1], norm=norm)
+    _check_norm(norm)
+    return _wrap(impl, x, "hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: ihfft (real input) on the LAST axis first, then
+    inverse FFT over the leading axes (reference: fft.py:781)."""
+    def impl(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        n = None if s is None else s[-1]
+        inner = jnp.fft.ihfft(a, n=n, axis=ax[-1], norm=norm)
+        lead_s = None if s is None else s[:-1]
+        return jnp.fft.ifftn(inner, s=lead_s, axes=ax[:-1], norm=norm)
+    _check_norm(norm)
+    return _wrap(impl, x, "ihfftn")
+
+
+# -- helpers ----------------------------------------------------------------
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(
+        dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(
+        dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return _wrap(jnp.fft.fftshift, x, "fftshift", axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _wrap(jnp.fft.ifftshift, x, "ifftshift", axes=axes)

@@ -290,7 +290,7 @@ class TrainStep:
 
         return [put(a) for a in raw]
 
-    def _make_step(self, treedef, training=True):
+    def _make_step(self, treedef, training=True, check_finite=False):
         layer, loss_fn, optimizer = self.layer, self.loss_fn, self.optimizer
         frozen = self.frozen
 
@@ -310,18 +310,28 @@ class TrainStep:
                 compute_loss, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr, t)
+            if check_finite:
+                # NaN/Inf debug under jit (reference: FLAGS_check_nan_inf +
+                # nan_inf_utils: per-op device-side scan; here per-gradient
+                # + loss flags, cheap booleans fetched with the loss)
+                flags = {"loss": jnp.isfinite(loss)}
+                for k, g in grads.items():
+                    flags["grad:" + k] = jnp.isfinite(g).all()
+                return new_params, new_bufs, new_opt, loss, flags
             return new_params, new_bufs, new_opt, loss
 
         return step
 
     def __call__(self, *batch):
+        from ..core.flags import get_flag
         raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         raw = self._place_batch(raw)
         flat, treedef = jax.tree_util.tree_flatten(raw)
-        sig = (_sig_of(flat)[0], treedef)
+        check = bool(get_flag("check_nan_inf"))
+        sig = (_sig_of(flat)[0], treedef, check)
         jitted = self._jitted.get(sig)
         if jitted is None:
-            fn = self._make_step(treedef)
+            fn = self._make_step(treedef, check_finite=check)
             donate = (0, 2) if self._donate else ()
             jitted = jax.jit(fn, donate_argnums=donate)
             self._jitted[sig] = jitted
@@ -329,8 +339,17 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = make_rng("train_step")
-        self.params, self.buffers, self.opt_state, loss = jitted(
-            self.params, self.buffers, self.opt_state, lr, t, key, flat)
+        out = jitted(self.params, self.buffers, self.opt_state, lr, t, key,
+                     flat)
+        if check:
+            self.params, self.buffers, self.opt_state, loss, flags = out
+            bad = [k for k, ok in flags.items() if not bool(ok)]
+            if bad:
+                raise RuntimeError(
+                    f"NaN/Inf detected at step {self.step_count} in: "
+                    f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
+        else:
+            self.params, self.buffers, self.opt_state, loss = out
         return Tensor(loss)
 
     def sync_to_layer(self):
@@ -341,6 +360,96 @@ class TrainStep:
         for k, b in self.layer.named_buffers():
             if k in self.buffers:
                 b._data = self.buffers[k]
+
+    # -- checkpoint/resume -------------------------------------------------
+    def state_dict(self):
+        """Full training state: params + frozen + buffers + optimizer slots
+        + step count + RNG, enough to resume bit-exactly (reference:
+        framework/io.py:553 save of model+opt state; SURVEY §5 resume)."""
+        import numpy as np
+
+        from ..core.random import default_generator
+
+        def host(tree):
+            return jax.tree_util.tree_map(
+                lambda a: np.asarray(a) if hasattr(a, "shape") else a, tree)
+
+        return {
+            "params": host(self.params),
+            "frozen": host(self.frozen),
+            "buffers": host(self.buffers),
+            "opt_state": host(self.opt_state),
+            "step_count": self.step_count,
+            "rng_state": default_generator().get_state(),
+            "lr": self.optimizer.get_lr(),
+        }
+
+    def set_state_dict(self, state):
+        """Restore a state_dict; re-applies SPMD layouts when a mesh is
+        active so resume preserves shardings."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..core.random import default_generator
+
+        def put(k, a, spec=None):
+            if not hasattr(a, "shape"):
+                return a
+            if self.mesh is not None:
+                return jax.device_put(
+                    a, NamedSharding(self.mesh, spec or P()))
+            return jnp.asarray(a)
+
+        if self.mesh is not None:
+            self._specs = self._param_specs()
+            self.params = {k: put(k, v, self._specs.get(k))
+                           for k, v in state["params"].items()}
+            self.opt_state = {
+                k: jax.tree_util.tree_map(
+                    lambda a, k=k: jax.device_put(
+                        a, NamedSharding(self.mesh,
+                                         self._slot_spec(k, a.shape)))
+                    if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0
+                    else a, v)
+                for k, v in state["opt_state"].items()}
+        else:
+            self.params = {k: jnp.asarray(v)
+                           for k, v in state["params"].items()}
+            self.opt_state = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) if hasattr(a, "shape") else a,
+                state["opt_state"])
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding as _NS
+            frozen_specs = {k: getattr(p, "spec", None) or P()
+                            for k, p in self.layer.named_parameters()
+                            if k not in self.params}
+            self.frozen = {
+                k: jax.device_put(v, _NS(self.mesh,
+                                         frozen_specs.get(k, P())))
+                for k, v in state["frozen"].items()}
+            self.buffers = {k: jax.device_put(v, _NS(self.mesh, P()))
+                            for k, v in state["buffers"].items()}
+        else:
+            self.frozen = {k: jnp.asarray(v)
+                           for k, v in state["frozen"].items()}
+            self.buffers = {k: jnp.asarray(v)
+                            for k, v in state["buffers"].items()}
+        self.step_count = int(state["step_count"])
+        if state.get("rng_state") is not None:
+            default_generator().set_state(state["rng_state"])
+        if state.get("lr") is not None and hasattr(self.optimizer, "set_lr"):
+            try:
+                self.optimizer.set_lr(state["lr"])
+            except Exception:
+                pass
+        self.sync_to_layer()
+
+    def save(self, path: str):
+        from ..framework.io import save as fsave
+        fsave(self.state_dict(), path)
+
+    def load(self, path: str):
+        from ..framework.io import load as fload
+        self.set_state_dict(fload(path))
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -372,8 +481,20 @@ def save(layer, path, input_spec=None, **configs):
         was_training = layer.training
         layer.eval()
         try:
-            lowered = jax.jit(pure).lower(params, buffers, *example)
+            jitted = jax.jit(pure)
+            lowered = jitted.lower(params, buffers, *example)
             stablehlo = lowered.as_text(dialect="stablehlo")
+            # portable executable blob: params/buffers are BAKED as the
+            # first two arguments; load() rebinds the pickled values
+            from jax import export as jexport
+            exp = jexport.export(jitted)(
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+                *[jax.ShapeDtypeStruct(e.shape, e.dtype) for e in example])
+            with open(path + ".jaxexport", "wb") as f:
+                f.write(exp.serialize())
         finally:
             if was_training:
                 layer.train()
@@ -384,13 +505,58 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({k: np.asarray(v) for k, v in {**params, **buffers}.items()}, f)
     with open(path + ".pdmodel.meta", "wb") as f:
-        pickle.dump(meta, f)
+        pickle.dump({**meta, "param_names": list(params),
+                     "buffer_names": list(buffers)}, f)
+
+
+class TranslatedLayer:
+    """Runnable model restored from a jit.save export (reference:
+    fluid/dygraph/io.py TranslatedLayer / jit.py:1162 TracedLayer): holds
+    the deserialized executable + parameter arrays and is called like the
+    original layer (positional Tensors/arrays in, Tensor out)."""
+
+    def __init__(self, exported, params, buffers, meta):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self._meta = meta
+
+    @property
+    def program(self):   # parity shim: the export object is the "program"
+        return self._exported
+
+    def state_dict(self):
+        return {**self._params, **self._buffers}
+
+    def __call__(self, *inputs):
+        raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        out = self._exported.call(self._params, self._buffers, *raw)
+        if isinstance(out, (tuple, list)):
+            outs = [Tensor(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
 
 
 def load(path, **configs):
-    """Load params saved by jit.save into a dict (model class must be
-    reconstructed by the caller; full TranslatedLayer support via the
-    inference module)."""
+    """Restore a jit.save export.
+
+    Returns a runnable :class:`TranslatedLayer` when the executable blob
+    exists (saved with input_spec); otherwise the raw params dict
+    (weights-only save). reference: fluid/io.py:1246 load_inference_model."""
+    import os
     import pickle
+
     with open(path + ".pdiparams", "rb") as f:
-        return pickle.load(f)
+        arrays = pickle.load(f)
+    if not os.path.exists(path + ".jaxexport"):
+        return arrays
+    with open(path + ".pdmodel.meta", "rb") as f:
+        meta = pickle.load(f)
+    with open(path + ".jaxexport", "rb") as f:
+        from jax import export as jexport
+        exported = jexport.deserialize(f.read())
+    params = {k: jnp.asarray(arrays[k]) for k in meta.get("param_names", [])}
+    buffers = {k: jnp.asarray(arrays[k])
+               for k in meta.get("buffer_names", [])}
+    return TranslatedLayer(exported, params, buffers, meta)

@@ -1230,7 +1230,45 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-style sampling TBD")
+    """Partial-FC class-center sampling (reference:
+    nn/functional/common.py:1586, class_center_sample_op.cu): keep every
+    positive class in `label`, top up with uniformly sampled negatives to
+    `num_samples`, and remap labels into the sampled index space (labels
+    whose class was not sampled keep... all positives are always sampled,
+    so every label remaps). Returns (remapped_label, sampled_class_index).
+
+    Host-side op (eager data-prep, like the reference's usage before the
+    sharded margin-softmax matmul); RNG comes from the global generator.
+    """
+    import numpy as np
+
+    from ..core.random import default_generator
+
+    arr = np.asarray(label._data if isinstance(label, Tensor) else label)
+    if arr.ndim != 1:
+        raise ValueError("class_center_sample expects 1-D labels")
+    if num_samples > num_classes:
+        raise ValueError(f"num_samples {num_samples} > num_classes "
+                         f"{num_classes}")
+    positives = np.unique(arr)
+    if len(positives) >= num_samples:
+        sampled = positives
+    else:
+        seed_key = default_generator().next_key()
+        import jax
+        seed = int(jax.random.randint(seed_key, (), 0, 2**31 - 1))
+        rng = np.random.default_rng(seed)
+        mask = np.ones(num_classes, bool)
+        mask[positives] = False
+        negatives = np.nonzero(mask)[0]
+        extra = rng.choice(negatives, num_samples - len(positives),
+                           replace=False)
+        sampled = np.sort(np.concatenate([positives, extra]))
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    out_label = remap[arr]
+    return (Tensor(jnp.asarray(out_label, jnp.int32)),
+            Tensor(jnp.asarray(sampled, jnp.int32)))
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -1,0 +1,161 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+reference parity: python/paddle/signal.py (frame:32, overlap_add:153,
+stft:236, istft:390 — including center padding, window application,
+onesided spectra and NOLA normalization on reconstruction).
+
+TPU-native: frames are gathered with a static [num_frames, frame_length]
+index matrix (one jnp.take — XLA turns it into an efficient gather);
+overlap-add is a segment_sum over the same index map. Everything is
+jit-compilable with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice into overlapping frames along ``axis`` (reference:
+    signal.py:32). axis=-1: [..., seq] -> [..., frame_length, num_frames];
+    axis=0: [seq, ...] -> [num_frames, frame_length, ...]."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    x = _as_tensor(x)
+    seq = x.shape[axis]
+    if frame_length > seq:
+        raise ValueError(f"frame_length {frame_length} > seq {seq}")
+    n_frames = 1 + (seq - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+
+    def impl(a):
+        taken = jnp.take(a, idx, axis=axis if axis >= 0 else a.ndim - 1)
+        if axis in (-1, a.ndim - 1):
+            # [..., n_frames, frame_length] -> [..., frame_length, n_frames]
+            return jnp.swapaxes(taken, -1, -2)
+        return taken                      # axis == 0: [n_frames, fl, ...]
+    return apply(impl, x, name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (reference: signal.py:153). axis=-1:
+    [..., frame_length, n_frames] -> [..., seq]."""
+    x = _as_tensor(x)
+
+    def impl(a):
+        if axis in (-1, a.ndim - 1):
+            fl, nf = a.shape[-2], a.shape[-1]
+            frames = jnp.swapaxes(a, -1, -2)       # [..., nf, fl]
+        else:
+            nf, fl = a.shape[0], a.shape[1]
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., nf, fl]
+        seq = (nf - 1) * hop_length + fl
+        starts = jnp.arange(nf) * hop_length
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (nf * fl,))
+        out = jax.vmap(
+            lambda row: jnp.zeros((seq,), a.dtype).at[idx].add(row)
+        )(flat.reshape((-1, nf * fl)))
+        out = out.reshape(frames.shape[:-2] + (seq,))
+        if axis in (-1, a.ndim - 1):
+            return out
+        return jnp.moveaxis(out, -1, 0)
+    return apply(impl, x, name="overlap_add")
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """STFT (reference: signal.py:236). x: [..., seq_len]. Returns
+    [..., n_fft//2+1 or n_fft, num_frames] complex."""
+    x = _as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[-1] != win_length:
+            raise ValueError("window length mismatch")
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    # center the window inside n_fft
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def impl(a, wa):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        seq = a.shape[-1]
+        nf = 1 + (seq - n_fft) // hop_length
+        starts = jnp.arange(nf) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = jnp.take(a, idx, axis=a.ndim - 1)     # [..., nf, n_fft]
+        frames = frames * wa
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))     # [..., nf, bins]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)              # [..., bins, nf]
+
+    return apply(impl, x, Tensor(w), name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """ISTFT with NOLA normalization (reference: signal.py:390).
+    x: [..., bins, num_frames] complex."""
+    x = _as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def impl(a, wa):
+        spec = jnp.swapaxes(a, -1, -2)                  # [..., nf, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)  # [..., nf, n_fft]
+        frames = frames * wa
+        nf = frames.shape[-2]
+        seq = (nf - 1) * hop_length + n_fft
+        starts = jnp.arange(nf) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames.reshape((-1, nf * n_fft))
+        sig = jax.vmap(
+            lambda row: jnp.zeros((seq,), frames.dtype).at[idx].add(row)
+        )(flat)
+        sig = sig.reshape(frames.shape[:-2] + (seq,))
+        # NOLA: divide by the summed squared window envelope
+        wsq = jnp.tile(wa * wa, (nf, 1)).reshape(-1)
+        envelope = jnp.zeros((seq,), wa.dtype).at[idx].add(wsq)
+        sig = sig / jnp.where(envelope > 1e-11, envelope, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2:seq - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply(impl, x, Tensor(w), name="istft")

@@ -82,27 +82,69 @@ def data(name, shape, dtype="float32", lod_level=0):
     return t
 
 
-class Executor:
-    """Compatibility Executor: runs a python callable as the 'program'.
+class CompiledProgram:
+    """A jit-compiled pure function over named feeds (the working analogue
+    of the reference's CompiledProgram, compiler.py). Built from a python
+    callable; the Executor compiles once per feed signature and caches."""
 
-    For real static-style training use paddle_tpu.jit.TrainStep — this class
-    exists so `exe.run(feed=..., fetch_list=...)` code keeps a familiar shape.
+    def __init__(self, fn):
+        self.fn = fn
+        self._cache = {}
+
+    def _run(self, feed: Dict):
+        names = tuple(sorted(feed))
+        arrs = {k: (v._data if isinstance(v, Tensor)
+                    else jax.numpy.asarray(v)) for k, v in feed.items()}
+        sig = (names, tuple((tuple(a.shape), str(a.dtype))
+                            for a in (arrs[n] for n in names)))
+        jitted = self._cache.get(sig)
+        if jitted is None:
+            def pure(kw):
+                out = self.fn(**{k: Tensor(v) for k, v in kw.items()})
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in outs]
+            jitted = jax.jit(pure)
+            self._cache[sig] = jitted
+        return jitted(arrs)
+
+
+class Executor:
+    """Executor over callables / CompiledProgram.
+
+    The reference executes serialized ProgramDescs (executor.py:1065); the
+    TPU-native 'program' is a traceable python callable — `run` jit
+    compiles it (cached per feed signature) and fetches numpy results. For
+    training loops prefer paddle_tpu.jit.TrainStep (donated buffers,
+    optimizer fused into the step).
     """
 
     def __init__(self, place=None):
         self.place = place
+        self._compiled: Dict[int, CompiledProgram] = {}
 
-    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
-        if callable(program):
-            out = program(**(feed or {}))
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            if return_numpy:
-                return [np.asarray(o.data) if isinstance(o, Tensor) else np.asarray(o)
-                        for o in outs]
-            return list(outs)
-        raise TypeError(
-            "paddle_tpu.static.Executor runs python callables; build models "
-            "eagerly and use jit.TrainStep for compiled training.")
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if isinstance(program, CompiledProgram):
+            outs = program._run(feed or {})
+        elif callable(program):
+            # memoize per callable: repeated exe.run(fn, ...) hits the same
+            # jit cache instead of retracing+recompiling every call
+            cp = self._compiled.get(id(program))
+            if cp is None or cp.fn is not program:
+                cp = CompiledProgram(program)
+                self._compiled[id(program)] = cp
+            outs = cp._run(feed or {})
+        else:
+            raise TypeError(
+                "paddle_tpu.static.Executor runs python callables or "
+                "CompiledProgram (the TPU-native 'program'); legacy "
+                "ProgramDesc graphs do not exist in this framework — build "
+                "models eagerly and use jit.TrainStep for compiled "
+                "training.")
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
 
 
 # nn facade for static-style layer helpers

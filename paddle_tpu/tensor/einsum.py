@@ -1,0 +1,30 @@
+"""einsum.
+
+reference parity: python/paddle/tensor/einsum.py:731 — supports explicit
+('ij,jk->ik') and implicit ('ij,jk') forms, ellipsis broadcasting, traces
+and reductions.
+
+TPU-native: delegates to jnp.einsum (XLA contracts on the MXU with its own
+contraction-order planner); the `apply` wrapper threads the eager tape and
+the framework matmul-precision policy.
+"""
+
+from __future__ import annotations
+
+from ..core.flags import matmul_precision
+from ..core.tensor import Tensor, apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation: str, *operands):
+    import jax.numpy as jnp
+
+    ts = [o if isinstance(o, Tensor) else Tensor(jnp.asarray(o))
+          for o in operands]
+    prec = matmul_precision()
+
+    def impl(*arrs):
+        return jnp.einsum(equation, *arrs, precision=prec)
+
+    return apply(impl, *ts, name="einsum")

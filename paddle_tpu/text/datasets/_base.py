@@ -1,0 +1,37 @@
+"""Shared plumbing for text datasets.
+
+reference parity: each dataset in python/paddle/text/datasets/ downloads
+its archive via paddle.dataset.common.DOWNLOAD_HOME and parses it lazily.
+This environment has no egress, so ``download=True`` without a local file
+raises with the expected path instead of fetching; parsing logic accepts
+the same archive formats the reference downloads.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...io.dataset import Dataset
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+class OfflineDataset(Dataset):
+    """Dataset resolved from a local file; no network access."""
+
+    NAME = "dataset"
+    FILENAME = "data"
+
+    def _resolve(self, data_file, download):
+        if data_file:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(data_file)
+            return data_file
+        cached = os.path.join(DATA_HOME, self.NAME, self.FILENAME)
+        if os.path.exists(cached):
+            return cached
+        raise RuntimeError(
+            f"{type(self).__name__}: no network egress is available; place "
+            f"the archive at {cached} or pass data_file= explicitly "
+            f"(reference downloads it from the paddle dataset mirror)")

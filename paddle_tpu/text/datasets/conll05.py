@@ -1,0 +1,153 @@
+"""CoNLL-2005 SRL dataset (reference parity: text/datasets/conll05.py —
+test.wsj words/props gz files inside the release tar, external
+word/verb/target dicts; samples are the standard 9-field SRL encoding:
+words, 5 verb-context windows, predicate, mark, BIO labels)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+import numpy as np
+
+from ._base import DATA_HOME, OfflineDataset
+
+UNK_IDX = 0
+
+
+class Conll05st(OfflineDataset):
+    NAME = "conll05st"
+    FILENAME = "conll05st-tests.tar.gz"
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self._path = self._resolve(data_file, download)
+        home = os.path.join(DATA_HOME, self.NAME)
+        self.word_dict_file = word_dict_file or os.path.join(
+            home, "wordDict.txt")
+        self.verb_dict_file = verb_dict_file or os.path.join(
+            home, "verbDict.txt")
+        self.target_dict_file = target_dict_file or os.path.join(
+            home, "targetDict.txt")
+        self.emb_file = emb_file or os.path.join(home, "emb")
+        for f in (self.word_dict_file, self.verb_dict_file,
+                  self.target_dict_file):
+            if not os.path.exists(f):
+                raise RuntimeError(
+                    f"Conll05st: dictionary {f} missing; no egress to fetch "
+                    "it — pass *_dict_file paths explicitly")
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for tag in tags:
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    @staticmethod
+    def _expand_props(prop_cols):
+        """One proposition column of CoNLL star notation -> BIO tags."""
+        seq = []
+        cur, inside = "O", False
+        for tok in prop_cols:
+            if tok == "*":
+                seq.append("I-" + cur if inside else "O")
+            elif tok == "*)":
+                seq.append("I-" + cur)
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1:tok.find("*")]
+                seq.append("B-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                seq.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"Unexpected label: {tok}")
+        return seq
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self._path) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, cols = [], []
+                for wline, pline in zip(words, props):
+                    word = wline.decode("utf-8", "ignore").strip()
+                    fields = pline.decode("utf-8", "ignore").strip().split()
+                    if not fields:                     # sentence boundary
+                        if cols:
+                            verbs = [v for v in cols[0] if v != "-"]
+                            for i in range(1, len(cols)):
+                                self.sentences.append(sent)
+                                self.predicates.append(verbs[i - 1])
+                                self.labels.append(
+                                    self._expand_props(cols[i]))
+                        sent, cols = [], []
+                        continue
+                    sent = sent + [word] if sent else [word]
+                    if not cols:
+                        cols = [[] for _ in fields]
+                    for i, fld in enumerate(fields):
+                        cols[i].append(fld)
+
+    def __getitem__(self, idx):
+        sentence, predicate = self.sentences[idx], self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, fallback in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                    (0, "0", None), (1, "p1", "eos"),
+                                    (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fallback
+        wd = self.word_dict
+        word_idx = [wd.get(w, UNK_IDX) for w in sentence]
+        rows = [np.array(word_idx)]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            rows.append(np.array([wd.get(ctx[name], UNK_IDX)] * n))
+        rows.append(np.array([self.predicate_dict.get(predicate)] * n))
+        rows.append(np.array(mark))
+        rows.append(np.array([self.label_dict.get(t) for t in labels]))
+        return tuple(rows)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if not os.path.exists(self.emb_file):
+            raise RuntimeError(f"embedding file {self.emb_file} missing")
+        return np.loadtxt(self.emb_file, dtype=np.float32)

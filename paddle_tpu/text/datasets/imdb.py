@@ -1,0 +1,66 @@
+"""IMDB sentiment dataset (reference parity: text/datasets/imdb.py).
+
+Parses the aclImdb tar: builds a frequency-cutoff word dict over
+train+test pos/neg docs (punctuation stripped, lowercased), then encodes
+the requested split. Label 0 = positive, 1 = negative (reference order)."""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ._base import OfflineDataset
+
+_PUNCT = str.maketrans("", "", string.punctuation)
+
+
+class Imdb(OfflineDataset):
+    NAME = "imdb"
+    FILENAME = "aclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        self._path = self._resolve(data_file, download)
+        self.word_idx = self._build_dict(cutoff)
+        self._encode()
+
+    def _docs(self, pattern):
+        rx = re.compile(pattern)
+        with tarfile.open(self._path) as tf:
+            for m in tf:
+                if m.isfile() and rx.match(m.name):
+                    text = tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").rstrip("\n\r")
+                    yield text.translate(_PUNCT).lower().split()
+
+    def _build_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        for doc in self._docs(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$"):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _encode(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            for doc in self._docs(rf"aclImdb/{self.mode}/{sub}/.*\.txt$"):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)

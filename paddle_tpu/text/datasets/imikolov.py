@@ -1,0 +1,75 @@
+"""PTB language-model dataset (reference parity: text/datasets/imikolov.py).
+
+Parses simple-examples tar (ptb.train/valid.txt): builds a min-frequency
+word dict (with <s>/<e> sentence markers, <unk> last), yields NGRAM windows
+or full SEQ id lists."""
+
+from __future__ import annotations
+
+import collections
+import tarfile
+
+import numpy as np
+
+from ._base import OfflineDataset
+
+
+class Imikolov(OfflineDataset):
+    NAME = "imikolov"
+    FILENAME = "simple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        data_type = data_type.upper()
+        assert data_type in ("NGRAM", "SEQ"), data_type
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.data_type, self.mode = data_type, mode
+        self.window_size = window_size
+        self._path = self._resolve(data_file, download)
+        self.word_idx = self._build_dict(min_word_freq)
+        self._load()
+
+    def _lines(self, split):
+        name = f"./simple-examples/data/ptb.{split}.txt"
+        with tarfile.open(self._path) as tf:
+            f = tf.extractfile(name)
+            for line in f:
+                yield line.decode("utf-8", "ignore").strip().split()
+
+    def _build_dict(self, min_freq):
+        freq = collections.defaultdict(int)
+        for split in ("train", "valid"):
+            for words in self._lines(split):
+                for w in words:
+                    freq[w] += 1
+                freq["<s>"] += 1
+                freq["<e>"] += 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > min_freq),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        split = "train" if self.mode == "train" else "valid"
+        for words in self._lines(split):
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] + words + ["<e>"]]
+            if self.data_type == "NGRAM":
+                if self.window_size <= 0:
+                    raise ValueError("NGRAM needs window_size > 0")
+                for i in range(self.window_size, len(ids) + 1):
+                    self.data.append(tuple(ids[i - self.window_size:i]))
+            else:
+                self.data.append(ids)
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx]) \
+            if self.data_type == "NGRAM" else np.array(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,105 @@
+"""MovieLens ml-1m dataset (reference parity: text/datasets/movielens.py —
+zip with movies.dat/users.dat/ratings.dat '::'-separated, latin encoding;
+each sample = user features + movie features + [rating*2-5])."""
+
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from ._base import OfflineDataset
+
+_TITLE_RE = re.compile(r"^(.*)\((\d+)\)$")
+_AGES = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, title_dict):
+        return [
+            np.array(self.index),
+            np.array([categories_dict[c] for c in self.categories]),
+            np.array([title_dict[w.lower()] for w in self.title.split()]),
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.gender = gender == "M"
+        self.age = _AGES.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [np.array(self.index), np.array(int(self.gender)),
+                np.array(self.age), np.array(self.job_id)]
+
+
+class Movielens(OfflineDataset):
+    NAME = "sentiment"          # reference caches under 'sentiment'
+    FILENAME = "ml-1m.zip"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self._path = self._resolve(data_file, download)
+        np.random.seed(rand_seed)
+        self._load_meta()
+        self._load_ratings()
+
+    def _load_meta(self):
+        self.movie_info, self.user_info = {}, {}
+        self.movie_title_dict, self.categories_dict = {}, {}
+        titles, cats = set(), set()
+        with zipfile.ZipFile(self._path) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, categories = line.decode(
+                        "latin-1").strip().split("::")
+                    categories = categories.split("|")
+                    cats.update(categories)
+                    title = _TITLE_RE.match(title).group(1)
+                    self.movie_info[int(mid)] = MovieInfo(
+                        mid, categories, title)
+                    titles.update(w.lower() for w in title.split())
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode(
+                        "latin-1").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+        self.movie_title_dict = {w: i for i, w in enumerate(titles)}
+        self.categories_dict = {c: i for i, c in enumerate(cats)}
+
+    def _load_ratings(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self._path) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode(
+                        "latin-1").strip().split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [np.array([float(rating) * 2 - 5.0])])
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,44 @@
+"""UCI housing dataset (reference parity: text/datasets/uci_housing.py).
+
+Parses the whitespace-delimited housing.data file: 13 features + target,
+features min/max-normalized over the WHOLE corpus, first 80% train /
+last 20% test (the reference's split)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ._base import OfflineDataset
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+
+class UCIHousing(OfflineDataset):
+    NAME = "uci_housing"
+    FILENAME = "housing.data"
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        path = self._resolve(data_file, download)
+        raw = np.loadtxt(path).astype(np.float32)
+        if raw.shape[1] != 14:
+            raise ValueError(f"expected 14 columns, got {raw.shape[1]}")
+        feats, target = raw[:, :13], raw[:, 13:]
+        lo, hi = feats.min(axis=0), feats.max(axis=0)
+        avg = feats.mean(axis=0)
+        feats = (feats - avg) / np.where(hi - lo == 0, 1, hi - lo)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = np.concatenate([feats[:split], target[:split]], 1)
+        else:
+            self.data = np.concatenate([feats[split:], target[split:]], 1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return np.asarray(row[:13]), np.asarray(row[13:])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,78 @@
+"""WMT14 en-fr translation dataset (reference parity:
+text/datasets/wmt14.py — tar with src.dict/trg.dict + tab-separated
+parallel text; <s>/<e>/<unk> ids 0/1/2; sequences longer than 80 dropped)."""
+
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ._base import OfflineDataset
+
+START, END, UNK = "<s>", "<e>", "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(OfflineDataset):
+    NAME = "wmt14"
+    FILENAME = "wmt14.tgz"
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test", "gen"), mode
+        assert dict_size > 0, "dict_size should be a positive number"
+        self.mode = mode
+        self.dict_size = dict_size
+        self._path = self._resolve(data_file, download)
+        self._load()
+
+    @staticmethod
+    def _to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.decode("utf-8", "ignore").strip()] = i
+        return out
+
+    def _load(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self._path) as tf:
+            src_name = [m.name for m in tf if m.name.endswith("src.dict")]
+            trg_name = [m.name for m in tf if m.name.endswith("trg.dict")]
+            assert len(src_name) == 1 and len(trg_name) == 1
+            self.src_dict = self._to_dict(tf.extractfile(src_name[0]),
+                                          self.dict_size)
+            self.trg_dict = self._to_dict(tf.extractfile(trg_name[0]),
+                                          self.dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for name in [m.name for m in tf if m.name.endswith(suffix)]:
+                for raw in tf.extractfile(name):
+                    parts = raw.decode("utf-8", "ignore").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, UNK_IDX)
+                           for w in [START] + parts[0].split() + [END]]
+                    trg = [self.trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[END]])
+
+    def get_dict(self, reverse=False):
+        src, trg = self.src_dict, self.trg_dict
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -1,0 +1,103 @@
+"""WMT16 en-de translation dataset (reference parity:
+text/datasets/wmt16.py — tar with per-language vocab files built on first
+use, <s>/<e>/<unk> ids 0/1/2, lowercase tokenization)."""
+
+from __future__ import annotations
+
+import collections
+import os
+import tarfile
+
+import numpy as np
+
+from ._base import DATA_HOME, OfflineDataset
+
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+
+class WMT16(OfflineDataset):
+    NAME = "wmt16"
+    FILENAME = "wmt16.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test", "val"), mode
+        assert lang in ("en", "de"), lang
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict sizes should be positive numbers"
+        self.mode = mode
+        self.lang = lang
+        self._path = self._resolve(data_file, download)
+        self.src_dict_size = min(src_dict_size, self._vocab_limit(lang))
+        trg_lang = "de" if lang == "en" else "en"
+        self.trg_dict_size = min(trg_dict_size, self._vocab_limit(trg_lang))
+        self.src_dict = self._load_dict(lang, self.src_dict_size)
+        self.trg_dict = self._load_dict(trg_lang, self.trg_dict_size)
+        self._load_data(trg_lang)
+
+    def _vocab_limit(self, lang):
+        return 10**9
+
+    def _dict_path(self, lang, size):
+        return os.path.join(DATA_HOME, self.NAME,
+                            f"wmt16.{lang}.dict.{size}")
+
+    def _load_dict(self, lang, size):
+        path = self._dict_path(lang, size)
+        if not os.path.exists(path):
+            self._build_dict(path, lang, size)
+        out = {}
+        with open(path, "rb") as f:
+            for i, line in enumerate(f):
+                out[line.decode("utf-8", "ignore").strip()] = i
+        return out
+
+    def _build_dict(self, path, lang, size):
+        freq = collections.defaultdict(int)
+        with tarfile.open(self._path) as tf:
+            f = tf.extractfile(f"wmt16/train")
+            col = 0 if lang == self.lang else 1
+            for raw in f:
+                parts = raw.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))]
+        words = [START_MARK, END_MARK, UNK_MARK] + words[:max(0, size - 3)]
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(words) + "\n")
+
+    def _load_data(self, trg_lang):
+        unk = self.src_dict.get(UNK_MARK, 2)
+        unk_t = self.trg_dict.get(UNK_MARK, 2)
+        s0, e0 = self.src_dict[START_MARK], self.src_dict[END_MARK]
+        s1, e1 = self.trg_dict[START_MARK], self.trg_dict[END_MARK]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self._path) as tf:
+            f = tf.extractfile(f"wmt16/{self.mode}")
+            for raw in f:
+                parts = raw.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [s0] + [self.src_dict.get(w, unk)
+                              for w in parts[0].split()] + [e0]
+                trg_words = [self.trg_dict.get(w, unk_t)
+                             for w in parts[1].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([s1] + trg_words)
+                self.trg_ids_next.append(trg_words + [e1])
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -1,0 +1,115 @@
+"""Aux-subsystem tests: static Executor, GradScaler dynamic loop, profiler,
+NaN/Inf debug under jit (SURVEY §5; VERDICT round-1 'test-free surface').
+
+reference analogues: test_executor_and_use_program_cache.py,
+test_grad_scaler.py / test_amp_*.py dynamic-loss-scaling asserts,
+test_profiler.py, test_nan_inf.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, static
+
+
+def test_static_executor_runs_callable_jitted():
+    lin = nn.Linear(4, 2)
+
+    def program(x):
+        return lin(x)
+
+    exe = static.Executor()
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    (out,) = exe.run(program, feed={"x": paddle.to_tensor(x)})
+    with paddle.no_grad():
+        ref = lin(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_compiled_program_caches():
+    calls = []
+
+    def program(x):
+        calls.append(1)            # traced once per signature
+        return x * 2
+
+    cp = static.CompiledProgram(program)
+    exe = static.Executor()
+    x = np.ones((2, 2), np.float32)
+    a = exe.run(cp, feed={"x": x})
+    b = exe.run(cp, feed={"x": x + 1})
+    assert len(calls) == 1         # second run hit the jit cache
+    np.testing.assert_allclose(a[0], 2 * x)
+    np.testing.assert_allclose(b[0], 2 * (x + 1))
+
+
+def test_static_executor_rejects_non_callable():
+    with pytest.raises(TypeError, match="callables"):
+        static.Executor().run(object())
+
+
+def test_grad_scaler_dynamic_scale_update():
+    from paddle_tpu.amp import GradScaler
+
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=2,
+                        incr_ratio=2.0, decr_ratio=0.5)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    # two good steps -> scale doubles once (incr_every_n_steps=2)
+    for _ in range(2):
+        loss = scaler.scale(model(x).sum())
+        loss.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+    assert scaler.get_loss_scaling() == 2048.0
+
+    # a NaN gradient step: update is skipped and the scale halves
+    w_before = np.asarray(model.weight._data).copy()
+    bad = model(x).sum() * float("nan")
+    scaler.scale(bad).backward()
+    scaler.step(opt)
+    scaler.update()
+    opt.clear_grad()
+    assert scaler.get_loss_scaling() == 1024.0
+    np.testing.assert_allclose(np.asarray(model.weight._data), w_before)
+
+
+def test_profiler_event_table():
+    from paddle_tpu import profiler as prof
+
+    prof.start_profiler()
+    with prof.RecordEvent("my_region"):
+        _ = paddle.to_tensor(np.ones((4, 4), np.float32)) * 2
+    prof.stop_profiler()
+    table = prof.summary()
+    assert "my_region" in table and "Calls" in table
+
+
+def test_trainstep_nan_check_under_jit():
+    from paddle_tpu.jit.to_static import TrainStep
+
+    model = nn.Linear(4, 2)
+
+    def loss_fn(layer, x, y):
+        return F.mse_loss(layer(x), y)
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = TrainStep(model, loss_fn, opt)
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 2), np.float32)
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        float(step(x, y))                     # clean step passes
+        x_bad = x.copy()
+        x_bad[0, 0] = np.nan
+        with pytest.raises(RuntimeError, match="NaN/Inf detected"):
+            step(x_bad, y)
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

@@ -1,0 +1,120 @@
+"""fft / signal / einsum / class_center_sample API tests.
+
+Analogue of the reference's spectral + einsum op tests
+(reference: test_fft.py — numpy parity over norms/axes; test_signal.py
+stft/istft round-trip; test_einsum_op.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import fft, signal
+
+
+def test_fft_roundtrip_and_numpy_parity():
+    x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+    got = fft.fft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    back = fft.ifft(fft.fft(paddle.to_tensor(x))).numpy()
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_rfft_norms(norm):
+    x = np.random.RandomState(1).randn(16).astype(np.float32)
+    got = fft.rfft(paddle.to_tensor(x), norm=norm).numpy()
+    np.testing.assert_allclose(got, np.fft.rfft(x, norm=norm),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fft2_fftn_fftshift_fftfreq():
+    x = np.random.RandomState(2).randn(4, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(fft.fft2(paddle.to_tensor(x)).numpy(),
+                               np.fft.fft2(x), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(fft.fftn(paddle.to_tensor(x)).numpy(),
+                               np.fft.fftn(x), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(fft.fftshift(paddle.to_tensor(x)).numpy(),
+                               np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(fft.fftfreq(10, 0.5).numpy(),
+                               np.fft.fftfreq(10, 0.5).astype(np.float32))
+
+
+def test_irfft_matches_numpy():
+    x = np.random.RandomState(3).randn(16).astype(np.float32)
+    spec = np.fft.rfft(x)
+    got = fft.irfft(paddle.to_tensor(spec)).numpy()
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-4)
+
+
+def test_hfft2_ihfft2_roundtrip():
+    x = np.random.RandomState(9).randn(4, 6).astype(np.float32)
+    spec = fft.ihfft2(paddle.to_tensor(x))
+    back = fft.hfft2(spec, s=(4, 6)).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_hfft_matches_numpy():
+    x = np.random.RandomState(10).randn(9).astype(np.complex64)
+    got = fft.hfft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.hfft(x), rtol=1e-3, atol=1e-3)
+
+
+def test_fft_bad_norm_raises():
+    with pytest.raises(ValueError, match="norm"):
+        fft.fft(paddle.to_tensor(np.zeros(4, np.float32)), norm="bogus")
+
+
+def test_frame_overlap_add_inverse():
+    x = np.random.RandomState(4).randn(2, 64).astype(np.float32)
+    framed = signal.frame(paddle.to_tensor(x), frame_length=16,
+                          hop_length=16)          # non-overlapping
+    assert tuple(framed.shape) == (2, 16, 4)
+    back = signal.overlap_add(framed, hop_length=16).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    x = np.random.RandomState(5).randn(2, 512).astype(np.float32)
+    n_fft, hop = 64, 16
+    window = np.hanning(n_fft).astype(np.float32)
+    spec = signal.stft(paddle.to_tensor(x), n_fft=n_fft, hop_length=hop,
+                       window=paddle.to_tensor(window))
+    assert tuple(spec.shape) == (2, n_fft // 2 + 1, 512 // hop + 1)
+    back = signal.istft(spec, n_fft=n_fft, hop_length=hop,
+                        window=paddle.to_tensor(window),
+                        length=512).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+
+def test_einsum_matmul_trace_and_grad():
+    a = np.random.RandomState(6).randn(4, 5).astype(np.float32)
+    b = np.random.RandomState(7).randn(5, 3).astype(np.float32)
+    got = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+    # implicit form + trace
+    sq = np.random.RandomState(8).randn(6, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.einsum("ii", paddle.to_tensor(sq)).numpy(),
+        np.trace(sq), rtol=1e-5)
+    # grads flow
+    ta = paddle.to_tensor(a)
+    ta.stop_gradient = False
+    paddle.einsum("ij,jk->ik", ta, paddle.to_tensor(b)).sum().backward()
+    np.testing.assert_allclose(np.asarray(ta.grad._data),
+                               np.tile(b.sum(1), (4, 1)), rtol=1e-4)
+
+
+def test_class_center_sample():
+    paddle.seed(7)
+    labels = np.array([3, 7, 7, 42, 3], np.int64)
+    remapped, sampled = F.class_center_sample(
+        paddle.to_tensor(labels), num_classes=100, num_samples=10)
+    s = sampled.numpy()
+    assert len(s) == 10 and len(set(s.tolist())) == 10
+    for c in (3, 7, 42):
+        assert c in s                        # positives always kept
+    r = remapped.numpy()
+    assert (s[r] == labels).all()            # remap is consistent

@@ -1,0 +1,100 @@
+"""Launcher/spawn env-protocol tests.
+
+Analogue of the reference's launch tests
+(reference: test_launch_coverage.py, test_fleet_launch.sh — workers get
+the right PADDLE_* env, failures propagate, logs land in log_dir).
+JAX's multi-process handshake itself is not exercised here (single-host
+CI); init_parallel_env consumes the same env vars these set.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import launch, main
+
+
+def _write(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launch_sets_env_protocol(tmp_path):
+    out = tmp_path / "out"
+    out.mkdir()
+    script = _write(tmp_path, f"""
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        with open(r"{out}" + "/rank_" + rank, "w") as f:
+            f.write(",".join([
+                os.environ["PADDLE_TRAINERS_NUM"],
+                os.environ["PADDLE_MASTER"],
+                os.environ["MASTER_PORT"],
+                os.environ["PADDLE_LOCAL_RANK"],
+            ]))
+    """)
+    rc = launch(script, [], nproc_per_node=2, port=23456)
+    assert rc == 0
+    got = sorted(os.listdir(out))
+    assert got == ["rank_0", "rank_1"]
+    body = (out / "rank_1").read_text().split(",")
+    assert body == ["2", "127.0.0.1", "23456", "1"]
+
+
+def test_launch_propagates_failure_and_stops_peers(tmp_path):
+    script = _write(tmp_path, """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(7)
+        time.sleep(30)          # would hang; must be terminated
+    """)
+    import time
+    t0 = time.time()
+    rc = launch(script, [], nproc_per_node=2)
+    assert rc == 7
+    assert time.time() - t0 < 20, "peers not terminated on failure"
+
+
+def test_launch_log_dir(tmp_path):
+    script = _write(tmp_path, """
+        import os
+        print("hello from", os.environ["PADDLE_TRAINER_ID"])
+    """)
+    rc = launch(script, [], nproc_per_node=2, log_dir=str(tmp_path / "logs"))
+    assert rc == 0
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    assert "hello from 0" in (tmp_path / "logs" / "workerlog.0").read_text()
+
+
+def test_main_cli_args(tmp_path):
+    script = _write(tmp_path, "pass")
+    rc = main(["--nproc_per_node", "1", script])
+    assert rc == 0
+
+
+def _spawn_target(path):
+    import os
+    with open(os.path.join(
+            path, f"spawned_{os.environ['PADDLE_TRAINER_ID']}"), "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def test_spawn_runs_workers(tmp_path):
+    from paddle_tpu.distributed.spawn_mod import spawn
+    ctx = spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    assert sorted(os.listdir(tmp_path)) == ["spawned_0", "spawned_1"]
+    assert (tmp_path / "spawned_0").read_text() == "2"
+
+
+def _failing_target():
+    sys.exit(3)
+
+
+def test_spawn_raises_on_failure():
+    from paddle_tpu.distributed.spawn_mod import spawn
+    with pytest.raises(RuntimeError, match="failed"):
+        spawn(_failing_target, nprocs=2)

@@ -1,0 +1,185 @@
+"""Pipeline-parallel engine tests.
+
+Analogue of the reference's PP tests
+(reference: test_parallel_dygraph_pipeline_parallel.py,
+hybrid_parallel_pp_layer.py — segmentation asserts; hybrid_parallel_pp_amp/
+alexnet.py — loss parity with the single-process model).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.distributed.meta_parallel import PipelineParallel
+from paddle_tpu.distributed.meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc)
+
+H = 16
+
+
+def _descs(n_blocks=4):
+    descs = [LayerDesc(nn.Linear, H, H)]
+    for _ in range(n_blocks):
+        descs.append(LayerDesc(nn.Linear, H, H))
+        descs.append(LayerDesc(nn.ReLU))
+    descs.append(LayerDesc(nn.Linear, H, 4))
+    return descs
+
+
+def test_uniform_segmentation():
+    bounds = SegmentLayers([0] * 10, num_parts=4, method="uniform") \
+        .do_segment()
+    assert bounds == [0, 3, 6, 8, 10]
+    sizes = [b - a for a, b in zip(bounds, bounds[1:])]
+    assert sum(sizes) == 10 and max(sizes) - min(sizes) <= 1
+
+
+def test_layer_name_segmentation():
+    descs = _descs(4)   # Linear, (Linear, ReLU)*4, Linear
+    seg = SegmentLayers(descs, num_parts=2, method="layer:Linear")
+    bounds = seg.do_segment()
+    assert bounds[0] == 0 and bounds[-1] == len(descs)
+    assert len(bounds) == 3
+
+
+def test_pipeline_layer_builds_all_stages():
+    pl = PipelineLayer(_descs(3), num_stages=2,
+                       loss_fn=lambda o, y: F.cross_entropy(o, y))
+    assert pl.num_stages == 2
+    n_params = len(list(pl.named_parameters()))
+    assert n_params == 5 * 2   # 5 Linears, weight+bias each
+
+
+def test_1f1b_schedule_order_and_memory_bound():
+    paddle.seed(0)
+    pl = PipelineLayer(_descs(2), num_stages=2,
+                       loss_fn=lambda o, y: F.cross_entropy(o, y))
+    pp = PipelineParallel(pl, accumulate_steps=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=pl.parameters())
+    x = np.random.RandomState(0).randn(8, H).astype(np.float32)
+    y = np.zeros((8,), np.int64)
+    pp.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+
+    log = pp._schedule_log
+    fwd_first = [e for e in log if e[0] == "F" and e[1] == 0]
+    bwd_last = [e for e in log if e[0] == "B" and e[1] == 1]
+    assert len(fwd_first) == 4 and len(bwd_last) == 4
+    # 1F1B: after warmup (S-1 = 1 forward), each forward is followed by a
+    # backward — microbatch 0's backward must happen BEFORE microbatch 3's
+    # forward (a GPipe schedule would do all forwards first)
+    first_b = next(i for i, e in enumerate(log) if e[0] == "B")
+    last_f = max(i for i, e in enumerate(log) if e[0] == "F")
+    assert first_b < last_f, "schedule is GPipe-like, not 1F1B"
+    # in-flight bound: at any point, #started-forward - #finished-backward
+    # microbatches <= num_stages
+    live = 0
+    peak = 0
+    seen_f, seen_b = set(), set()
+    for kind, s, mb in log:
+        if kind == "F" and mb not in seen_f:
+            seen_f.add(mb)
+        if kind == "B" and s == 0:
+            seen_b.add(mb)
+        live = len(seen_f) - len(seen_b)
+        peak = max(peak, live)
+    assert peak <= pl.num_stages, f"in-flight {peak} > stages"
+
+
+def test_loss_and_grad_parity_vs_single_model():
+    # identical init: build once, deep-copy state into a plain Sequential
+    paddle.seed(1)
+    loss_fn = lambda o, y: F.cross_entropy(o, y)      # noqa: E731
+    pl = PipelineLayer(_descs(2), num_stages=2, loss_fn=loss_fn)
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, H).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.int64)
+
+    # single-model reference: same layers called sequentially (stage walk),
+    # full batch, one backward
+    ref_loss = loss_fn(pl(paddle.to_tensor(x)), paddle.to_tensor(y))
+    ref_loss.backward()
+    ref_grads = {k: np.asarray(p.grad._data)
+                 for k, p in pl.named_parameters()}
+    for _, p in pl.named_parameters():
+        p.clear_gradient()
+
+    pp = PipelineParallel(pl, accumulate_steps=4)
+    pp_loss = pp.forward_backward_pipeline(
+        (paddle.to_tensor(x), paddle.to_tensor(y)))
+    np.testing.assert_allclose(float(ref_loss), float(pp_loss), rtol=1e-5)
+    for k, p in pl.named_parameters():
+        np.testing.assert_allclose(ref_grads[k], np.asarray(p.grad._data),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_shared_layer_desc_ties_weights():
+    V, D = 12, 8
+
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.table = self.create_parameter((V, D))
+
+        def forward(self, ids):
+            return self.table[ids]
+
+    def head_fwd(shared, h):
+        # tied LM head: h @ table^T
+        return paddle.matmul(h, shared.table, transpose_y=True)
+
+    descs = [
+        SharedLayerDesc("embed", Emb),
+        LayerDesc(nn.Linear, D, D),
+        SharedLayerDesc("embed", Emb, forward_func=head_fwd),
+    ]
+    pl = PipelineLayer(descs, num_stages=3,
+                       loss_fn=lambda o, y: F.cross_entropy(o, y))
+    # the table parameter exists exactly once
+    tables = [k for k, _ in pl.named_parameters() if "table" in k]
+    assert len(tables) == 1
+    pp = PipelineParallel(pl, accumulate_steps=2)
+    ids = np.random.RandomState(2).randint(0, V, (4,)).astype(np.int64)
+    labels = np.random.RandomState(3).randint(0, V, (4,)).astype(np.int64)
+    pp.forward_backward_pipeline(
+        (paddle.to_tensor(ids), paddle.to_tensor(labels)))
+    emb = pl.shared_layer("embed")
+    assert emb.table.grad is not None  # grads from BOTH call sites
+    assert float(np.abs(np.asarray(emb.table.grad._data)).sum()) > 0
+
+
+def test_scaler_loss_reported_unscaled():
+    from paddle_tpu.amp import GradScaler
+
+    paddle.seed(6)
+    pl = PipelineLayer(_descs(1), num_stages=2,
+                       loss_fn=lambda o, y: F.cross_entropy(o, y))
+    pp = PipelineParallel(pl, accumulate_steps=2)
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, H).astype(np.float32)
+    y = rng.randint(0, 4, (4,)).astype(np.int64)
+    data = (paddle.to_tensor(x), paddle.to_tensor(y))
+
+    plain = float(pp.forward_backward_pipeline(data))
+    for _, p in pl.named_parameters():
+        p.clear_gradient()
+    scaler = GradScaler(init_loss_scaling=4096.0)
+    scaled = float(pp.forward_backward_pipeline(data, scaler=scaler))
+    # the reported loss must be the true loss, not 4096x it
+    np.testing.assert_allclose(plain, scaled, rtol=1e-5)
+
+
+def test_train_batch_converges():
+    paddle.seed(4)
+    pl = PipelineLayer(_descs(2), num_stages=2,
+                       loss_fn=lambda o, y: F.cross_entropy(o, y))
+    pp = PipelineParallel(pl, accumulate_steps=2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=pl.parameters())
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, H).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int64)
+    data = (paddle.to_tensor(x), paddle.to_tensor(y))
+    losses = [float(pp.train_batch(data, opt)) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses[::6]

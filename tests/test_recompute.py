@@ -75,6 +75,22 @@ def test_dropout_mask_consistent_between_fwd_and_remat():
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
 
+def test_recompute_sequential_param_grads():
+    from paddle_tpu.distributed.fleet.utils import recompute_sequential
+
+    paddle.seed(12)
+    layers = [nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 8), nn.ReLU(),
+              nn.Linear(8, 4)]
+    x = paddle.to_tensor(np.random.RandomState(5).randn(4, 8)
+                         .astype(np.float32))
+    out = recompute_sequential({"segments": 2}, layers, x)
+    out.sum().backward()
+    for lyr in layers:
+        for _, p in lyr.named_parameters():
+            assert p.grad is not None, "segment params lost from grad path"
+            assert np.isfinite(np.asarray(p.grad._data)).all()
+
+
 def test_jitted_trainstep_with_recompute_converges():
     from paddle_tpu.jit.to_static import TrainStep
     from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,

@@ -48,9 +48,11 @@ def test_grad_scaler_no_double_unscale():
     scaler.scale(loss).backward()
     scaler.unscale_(opt)
     g = model.weight.grad.numpy().copy()
-    # documented pattern: unscale_ -> clip -> step must not re-divide
+    # documented pattern: unscale_ -> clip -> step -> update must not
+    # re-divide (reference: grad_scaler.py:159 docstring pattern)
     scaler.step(opt)
     np.testing.assert_allclose(g, model.weight.grad.numpy(), rtol=1e-6)
+    scaler.update()
 
     # explicit double unscale_ raises (reference parity)
     loss = model(x).sum()
@@ -58,6 +60,11 @@ def test_grad_scaler_no_double_unscale():
     scaler.unscale_(opt)
     with pytest.raises(RuntimeError):
         scaler.unscale_(opt)
+
+    # step without an intervening update also raises (reference parity)
+    scaler.step(opt)
+    with pytest.raises(RuntimeError):
+        scaler.step(opt)
 
 
 def test_weighted_cross_entropy_mean_matches_torch():

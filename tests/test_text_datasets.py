@@ -1,0 +1,172 @@
+"""Text dataset parser tests over synthetic archives.
+
+Analogue of the reference's dataset tests (reference:
+tests/unittests/test_datasets.py) — but the archives are generated
+in-test (no egress), exercising the same formats the reference downloads.
+"""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (Imdb, Imikolov, Movielens, UCIHousing,
+                                      WMT14, WMT16)
+
+
+def _add_bytes(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_uci_housing(tmp_path):
+    rows = np.random.RandomState(0).rand(50, 14).astype(np.float32)
+    path = tmp_path / "housing.data"
+    np.savetxt(path, rows)
+    train = UCIHousing(data_file=str(path), mode="train")
+    test = UCIHousing(data_file=str(path), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_uci_housing_missing_file_raises():
+    with pytest.raises(RuntimeError, match="no network egress"):
+        UCIHousing(data_file=None, mode="train")
+
+
+def test_imdb(tmp_path):
+    arc = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"a great great movie, great acting!",
+        "aclImdb/train/neg/0.txt": b"a bad movie; bad bad plot.",
+        "aclImdb/test/pos/0.txt": b"great fun",
+        "aclImdb/test/neg/0.txt": b"bad fun",
+    }
+    with tarfile.open(arc, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, name, data)
+    ds = Imdb(data_file=str(arc), mode="train", cutoff=1)
+    # 'great' x5 and 'bad' x5 pass cutoff 1; 'a'/'movie' x2, 'fun' x2 too
+    assert "great" in ds.word_idx and "bad" in ds.word_idx
+    assert len(ds) == 2
+    doc, label = ds[0]
+    assert label[0] == 0                      # first docs are positive
+    assert doc.dtype.kind == "i"
+
+
+def test_imikolov(tmp_path):
+    arc = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat\nthe dog sat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(arc, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    ds = Imikolov(data_file=str(arc), data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=0)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 2
+    seq = Imikolov(data_file=str(arc), data_type="SEQ", mode="test",
+                   min_word_freq=0)
+    assert len(seq) == 1                      # valid split has one line
+
+
+def test_movielens(tmp_path):
+    arc = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(arc, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::F::1::10::48067\n2::M::25::16::70072\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::3::978302109\n"
+                   "1::2::4::978301968\n")
+    ds = Movielens(data_file=str(arc), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    sample = ds[0]
+    assert len(sample) == 8                   # 4 user + 3 movie + rating
+    assert sample[-1].shape == (1,)
+
+
+def test_wmt14(tmp_path):
+    arc = tmp_path / "wmt14.tgz"
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    pairs = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(arc, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/src.dict", src_dict)
+        _add_bytes(tf, "wmt14/trg.dict", trg_dict)
+        _add_bytes(tf, "wmt14/train/train", pairs)
+    ds = WMT14(data_file=str(arc), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert trg[0] == ds.trg_dict["<s>"]
+    assert trg_next[-1] == ds.trg_dict["<e>"]
+
+
+def test_conll05(tmp_path):
+    from paddle_tpu.text.datasets import Conll05st
+    words = b"The\ncat\nsat\n\n"
+    # verb column + one proposition column (B-V on 'sat', A0 on 'The cat')
+    props = b"-\t(A0*\n-\t*)\nsit\t(V*)\n\n"
+    arc = tmp_path / "conll05st-tests.tar.gz"
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    with tarfile.open(arc, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   wbuf.getvalue())
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   pbuf.getvalue())
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("The\ncat\nsat\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("sit\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    ds = Conll05st(data_file=str(arc), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 1
+    fields = ds[0]
+    assert len(fields) == 9
+    word_idx, *ctx, pred, mark, labels = fields
+    assert list(word_idx) == [0, 1, 2]
+    assert list(mark) == [1, 1, 1]            # all within +-2 of the verb
+    lbl_names = {v: k for k, v in ds.label_dict.items()}
+    assert lbl_names[labels[2]] == "B-V"
+    assert lbl_names[labels[0]] == "B-A0"
+    assert lbl_names[labels[1]] == "I-A0"
+
+
+def test_wmt16(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path / "home"))
+    import importlib
+
+    import paddle_tpu.text.datasets._base as base
+    importlib.reload(base)
+    import paddle_tpu.text.datasets.wmt16 as wmt16_mod
+    importlib.reload(wmt16_mod)
+
+    arc = tmp_path / "wmt16.tar.gz"
+    pairs = (b"a cat\teine katze\nthe dog\tder hund\n")
+    with tarfile.open(arc, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", pairs)
+        _add_bytes(tf, "wmt16/val", pairs)
+        _add_bytes(tf, "wmt16/test", pairs)
+    ds = wmt16_mod.WMT16(data_file=str(arc), mode="train", src_dict_size=10,
+                         trg_dict_size=10, lang="en")
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == 0 and src[-1] == 1       # <s> ... <e>
+    d = ds.get_dict("en")
+    assert "<unk>" in d
