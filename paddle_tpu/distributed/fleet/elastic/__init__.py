@@ -1,0 +1,164 @@
+"""Elastic training: failure detection + restartable training loop.
+
+reference parity: fleet/elastic/manager.py:103-354 — ElasticManager watches
+etcd for host membership, decides HOLD/RESTART/COMPLETED/ERROR, kills and
+relaunches local trainers between min/max parallelism; env protocol
+PADDLE_ELASTIC_* (np range, fault tolerance level).
+
+TPU-native redesign: etcd is replaced by a file-based heartbeat registry
+(one small file per worker under a shared dir — on TPU pods typically NFS
+or the pod's shared filesystem; no external KV service is assumed), and
+the "kill+relaunch" model is the supervisor in `run_elastic`, which pairs
+with TrainStep.save/load (bit-exact resume, jit/to_static.py) so a restart
+resumes from the last good step instead of step 0. In-training device
+failure surfaces as an exception on the single controller — the restart
+model matches the reference's (no in-flight NCCL repair there either).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager", "run_elastic"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    RESTART = "restart"
+    HOLD = "hold"
+    ERROR = "error"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Worker membership via heartbeat files (reference: etcd hosts path).
+
+    Each worker touches ``<root>/worker_<rank>.hb`` with its pid and
+    timestamp; `watch()` classifies the cluster state: all expected workers
+    alive -> HOLD, a worker stale/dead but replaceable within
+    [min_np, max_np] -> RESTART, job marker complete -> COMPLETED.
+    """
+
+    def __init__(self, root: Optional[str] = None,
+                 rank: Optional[int] = None, np_: Optional[int] = None,
+                 min_np: Optional[int] = None, max_np: Optional[int] = None,
+                 timeout: float = 30.0, job_id: Optional[str] = None):
+        env = os.environ
+        base = root or env.get("PADDLE_ELASTIC_DIR",
+                               "/tmp/paddle_tpu_elastic")
+        # per-job namespace: a finished job's COMPLETED marker must not
+        # classify the next job (reference: etcd prefix = job_id)
+        job = job_id or env.get("PADDLE_ELASTIC_JOB_ID")
+        self.root = os.path.join(base, job) if job else base
+        self.rank = int(rank if rank is not None
+                        else env.get("PADDLE_TRAINER_ID", 0))
+        self.np = int(np_ if np_ is not None
+                      else env.get("PADDLE_TRAINERS_NUM", 1))
+        elastic = env.get("PADDLE_ELASTIC_NP", "")
+        if ":" in elastic:
+            lo, hi = elastic.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = int(min_np if min_np is not None else self.np)
+            self.max_np = int(max_np if max_np is not None else self.np)
+        self.timeout = timeout
+        os.makedirs(self.root, exist_ok=True)
+        self.enabled = self.max_np > 1 or "PADDLE_ELASTIC_NP" in env
+
+    # -- heartbeat ---------------------------------------------------------
+    def _hb_path(self, rank):
+        return os.path.join(self.root, f"worker_{rank}.hb")
+
+    def beat(self):
+        with open(self._hb_path(self.rank), "w") as f:
+            json.dump({"pid": os.getpid(), "ts": time.time()}, f)
+
+    def alive_workers(self):
+        now = time.time()
+        alive = []
+        for name in os.listdir(self.root):
+            if not name.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    hb = json.load(f)
+                if now - hb["ts"] <= self.timeout:
+                    alive.append(int(name[len("worker_"):-3]))
+            except (ValueError, OSError):
+                continue
+        return sorted(alive)
+
+    def mark_completed(self):
+        with open(os.path.join(self.root, "COMPLETED"), "w") as f:
+            f.write(str(time.time()))
+
+    # -- state machine (reference: manager.py:324 watch) -------------------
+    def watch(self) -> str:
+        if os.path.exists(os.path.join(self.root, "COMPLETED")):
+            return ElasticStatus.COMPLETED
+        alive = self.alive_workers()
+        if len(alive) >= self.np:
+            return ElasticStatus.HOLD
+        if len(alive) >= self.min_np:
+            return ElasticStatus.RESTART     # degraded but viable: rescale
+        return ElasticStatus.ERROR
+
+
+def run_elastic(train_fn: Callable[[Optional[str]], None],
+                checkpoint_path: str, max_restarts: int = 3,
+                manager: Optional[ElasticManager] = None):
+    """Supervised restartable training (the reference's relaunch loop,
+    manager.py LauncherInterface, folded into-process for the SPMD
+    single-controller model).
+
+    ``train_fn(resume_path_or_None)`` runs the training loop, calling
+    TrainStep.save(checkpoint_path) at intervals; on exception the
+    supervisor retries from the latest checkpoint up to max_restarts.
+    A background thread beats the heartbeat every timeout/3 so peers'
+    watch() sees this worker alive for the whole run.
+    """
+    import threading
+
+    mgr = manager or ElasticManager()
+    # stale COMPLETED from a previous job under the same root must not
+    # instantly "finish" this one
+    marker = os.path.join(mgr.root, "COMPLETED")
+    if os.path.exists(marker):
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+
+    stop = threading.Event()
+
+    def heartbeat_loop():
+        while not stop.is_set():
+            try:
+                mgr.beat()
+            except OSError:
+                pass
+            stop.wait(max(mgr.timeout / 3.0, 0.1))
+
+    hb = threading.Thread(target=heartbeat_loop, daemon=True)
+    hb.start()
+    restarts = 0
+    try:
+        while True:
+            resume = (checkpoint_path if os.path.exists(checkpoint_path)
+                      else None)
+            try:
+                result = train_fn(resume)
+                mgr.mark_completed()
+                return result
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                time.sleep(min(2.0 ** restarts, 30.0))
+    finally:
+        stop.set()

@@ -114,6 +114,42 @@ def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
     return layer
 
 
+_TP_COLUMN = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+              "linear1.weight")          # [in, out]: shard out over mp
+_TP_ROW = ("out_proj.weight", "linear2.weight")   # [in, out]: shard in
+_TP_COLUMN_BIAS = ("q_proj.bias", "k_proj.bias", "v_proj.bias",
+                   "linear1.bias")
+_VOCAB = ("word_embeddings.weight",)
+
+
+def apply_hybrid_specs(layer, mp_axis: str = "mp"):
+    """Stamp Megatron-style tensor-parallel PartitionSpecs onto a model
+    built from nn.MultiHeadAttention/TransformerEncoder by parameter-name
+    pattern (reference: the mp_layers rewrite the reference applies when
+    building hybrid models — here layout is declarative so stock layers
+    become TP-sharded without rewriting the model).
+
+    Column-parallel (out-dim sharded): q/k/v projections, ffn in-proj.
+    Row-parallel (in-dim sharded): attention out-proj, ffn out-proj — XLA
+    inserts the psum after it. Vocab embeddings shard over the vocab dim.
+    Everything else (norms, biases of row layers) stays replicated.
+    """
+    for name, p in layer.named_parameters():
+        if getattr(p, "spec", None) not in (None, P()):
+            continue                          # already placed explicitly
+        if name.endswith(_VOCAB):
+            p.spec = P(mp_axis, None)
+        elif name.endswith(_TP_COLUMN):
+            p.spec = P(None, mp_axis)
+        elif name.endswith(_TP_ROW):
+            p.spec = P(mp_axis, None)
+        elif name.endswith(_TP_COLUMN_BIAS):
+            p.spec = P(mp_axis)
+        else:
+            p.spec = P()
+    return layer
+
+
 def shard_map(body, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
     """jax.shard_map wrapper that records the mesh's axis names as *bound*
     for the dynamic extent of the body trace, so paddle_tpu.distributed

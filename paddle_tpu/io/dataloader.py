@@ -61,21 +61,108 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_init_fn,
             out_queue.put((batch_id, None, e))
 
 
+def _worker_loop_pipe(dataset, index_queue, conn, collate_fn, worker_init_fn,
+                      worker_id):
+    """Worker for the native-queue transport: batches leave as RAW pickled
+    frames over a dedicated pipe, so the consumer side deserializes exactly
+    once (reference: worker.py:341 shared-memory handoff — here the bytes
+    land in the C++ blocking queue instead of an mmap segment)."""
+    import pickle
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            payload = (batch_id, collate_fn(samples), None)
+        except Exception as e:
+            payload = (batch_id, None, e)
+        try:
+            conn.send_bytes(pickle.dumps(payload, protocol=4))
+        except (BrokenPipeError, OSError):
+            break
+    conn.close()
+
+
+def _drain_pipes(native_q, conns, stop_event):
+    """Forward raw pickled frames from worker pipes into the C++ queue.
+
+    Runs on a daemon thread holding NO reference to the iterator (weakref
+    lifecycle stays with the consumer); always closes the native queue on
+    exit so a blocked consumer raises instead of hanging.
+    """
+    from multiprocessing.connection import wait as conn_wait
+    try:
+        live = list(conns)
+        while live and not stop_event.is_set():
+            for conn in conn_wait(live, timeout=0.2):
+                try:
+                    frame = conn.recv_bytes()
+                except (EOFError, OSError):
+                    live.remove(conn)
+                    continue
+                native_q.put(frame)          # bounded: blocks in C
+    except Exception:
+        pass
+    finally:
+        native_q.close()
+
+
 class _MultiprocessIter:
     def __init__(self, loader):
         self.loader = loader
         ctx = mp.get_context("fork")
         self.index_queue = ctx.Queue()
-        self.out_queue = ctx.Queue()
+        self.out_queue = None
         self.workers = []
+        self._native_q = None
+        self._drain_thread = None
+        self._stop_event = threading.Event()
+        self._worker_conns = []
+
+        # Native C++ blocking-queue transport (the reference's
+        # reader-thread -> LoDTensorBlockingQueue stage,
+        # reader/blocking_queue.h): workers pickle ONCE into a pipe, the
+        # drain thread forwards raw bytes into bounded C-heap storage, the
+        # consumer unpickles once. Falls back to an mp.Queue.
+        if loader.use_shared_memory:
+            try:
+                from .native_queue import NativeBlockingQueue
+                self._native_q = NativeBlockingQueue(
+                    max(2, loader.prefetch_factor * loader.num_workers))
+            except Exception:
+                self._native_q = None
+        if self._native_q is None:
+            self.out_queue = ctx.Queue()
+
         for wid in range(loader.num_workers):
+            if self._native_q is not None:
+                r, w_conn = ctx.Pipe(duplex=False)
+                self._worker_conns.append(r)
+                target, sink = _worker_loop_pipe, w_conn
+            else:
+                target, sink = _worker_loop, self.out_queue
             w = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, self.index_queue, self.out_queue,
+                target=target,
+                args=(loader.dataset, self.index_queue, sink,
                       loader.collate_fn, loader.worker_init_fn, wid),
                 daemon=True)
             w.start()
             self.workers.append(w)
+            if self._native_q is not None:
+                sink.close()                 # parent keeps the read end
+
+        if self._native_q is not None:
+            self._drain_thread = threading.Thread(
+                target=_drain_pipes,
+                args=(self._native_q, list(self._worker_conns),
+                      self._stop_event),
+                daemon=True)
+            self._drain_thread.start()
+
         self.batch_iter = iter(loader.batch_sampler)
         self.send_id = 0
         self.recv_id = 0
@@ -84,6 +171,16 @@ class _MultiprocessIter:
         # prime the pipeline
         for _ in range(loader.num_workers * 2):
             self._send_next()
+
+    def _recv(self):
+        if self._native_q is not None:
+            import pickle
+            from .native_queue import QueueClosed, QueueKilled
+            try:
+                return pickle.loads(self._native_q.get())
+            except (QueueClosed, QueueKilled):
+                raise RuntimeError("DataLoader pipeline shut down")
+        return self.out_queue.get()
 
     def _send_next(self):
         if self.exhausted:
@@ -101,7 +198,7 @@ class _MultiprocessIter:
             self._shutdown()
             raise StopIteration
         while self.recv_id not in self.reorder:
-            batch_id, data, err = self.out_queue.get()
+            batch_id, data, err = self._recv()
             if err is not None:
                 self._shutdown()
                 raise err
@@ -112,6 +209,7 @@ class _MultiprocessIter:
         return data
 
     def _shutdown(self):
+        self._stop_event.set()
         for _ in self.workers:
             try:
                 self.index_queue.put(None)
@@ -122,6 +220,14 @@ class _MultiprocessIter:
             if w.is_alive():
                 w.terminate()
         self.workers = []
+        if self._native_q is not None:
+            self._native_q.kill()
+        for c in self._worker_conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._worker_conns = []
 
     def __del__(self):
         self._shutdown()
@@ -209,6 +315,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.worker_init_fn = worker_init_fn
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self.batch_size = batch_size
         self.drop_last = drop_last

@@ -1,0 +1,136 @@
+"""ctypes binding for the native blocking queue (C++ reader core).
+
+reference parity: the Python face of LoDTensorBlockingQueue
+(reference: operators/reader/blocking_queue.h + pybind bindings in
+pybind/reader_py.cc). Here the binding is ctypes over a C ABI — no
+pybind11 in the image — and the payloads are arbitrary byte buffers
+(pickled batches / raw numpy), with the copy into C-heap memory freeing
+the Python producer immediately.
+
+The shared library is compiled on first use with g++ and cached next to
+the source; `native_available()` reports whether the toolchain produced a
+usable library (callers fall back to queue.Queue).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "_native",
+                    "blocking_queue.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_native",
+                    "libblocking_queue.so")
+_lib_handle = None
+_build_lock = threading.Lock()
+
+
+class QueueClosed(Exception):
+    pass
+
+
+class QueueKilled(Exception):
+    pass
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _lib_handle
+    with _build_lock:
+        if _lib_handle is not None:
+            return _lib_handle
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC,
+                     "-o", _LIB],
+                    check=True, capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, FileNotFoundError, OSError):
+                # no toolchain: still try any existing library (git does
+                # not preserve mtimes, so a shipped .so may look stale)
+                if not os.path.exists(_LIB):
+                    return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.pq_create.restype = ctypes.c_void_p
+        lib.pq_create.argtypes = [ctypes.c_size_t]
+        lib.pq_destroy.argtypes = [ctypes.c_void_p]
+        lib.pq_send.restype = ctypes.c_int
+        lib.pq_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t]
+        lib.pq_receive.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.pq_receive.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_size_t),
+                                   ctypes.c_long,
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.pq_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.pq_close.argtypes = [ctypes.c_void_p]
+        lib.pq_kill.argtypes = [ctypes.c_void_p]
+        lib.pq_size.restype = ctypes.c_size_t
+        lib.pq_size.argtypes = [ctypes.c_void_p]
+        lib.pq_closed.restype = ctypes.c_int
+        lib.pq_closed.argtypes = [ctypes.c_void_p]
+        _lib_handle = lib
+        return lib
+
+
+def native_available() -> bool:
+    return _build() is not None
+
+
+class NativeBlockingQueue:
+    """Bounded blocking byte queue over the C++ core."""
+
+    def __init__(self, capacity: int = 8):
+        lib = _build()
+        if lib is None:
+            raise RuntimeError("native blocking queue unavailable "
+                               "(g++ build failed)")
+        self._lib = lib
+        self._q = lib.pq_create(capacity)
+        if not self._q:
+            raise ValueError("capacity must be > 0")
+
+    def put(self, data: bytes) -> None:
+        if not self._lib.pq_send(self._q, data, len(data)):
+            raise QueueClosed("queue closed")
+
+    def get(self, timeout: Optional[float] = None) -> bytes:
+        size = ctypes.c_size_t()
+        status = ctypes.c_int()
+        ms = -1 if timeout is None else int(timeout * 1000)
+        buf = self._lib.pq_receive(self._q, ctypes.byref(size), ms,
+                                   ctypes.byref(status))
+        st = status.value
+        if st == 1:
+            try:
+                return ctypes.string_at(buf, size.value)
+            finally:
+                self._lib.pq_free(buf)
+        if st == 0:
+            raise QueueClosed("queue closed and drained")
+        if st == -1:
+            raise TimeoutError("queue get timed out")
+        raise QueueKilled("queue killed")
+
+    def close(self) -> None:
+        self._lib.pq_close(self._q)
+
+    def kill(self) -> None:
+        self._lib.pq_kill(self._q)
+
+    def qsize(self) -> int:
+        return self._lib.pq_size(self._q)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_q", None):
+                self._lib.pq_destroy(self._q)
+                self._q = None
+        except Exception:
+            pass

@@ -1,0 +1,61 @@
+"""DataLoader multiprocess path through the native C++ blocking queue.
+
+reference analogue: test_multiprocess_dataloader_static/dynamic.py —
+worker processes + blocking-queue transport deliver every batch exactly
+once, in order, including error propagation.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+
+class _Range(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.full((4,), i, np.float32), np.int64(i % 4))
+
+    def __len__(self):
+        return self.n
+
+
+class _Faulty(_Range):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("poison sample")
+        return super().__getitem__(i)
+
+
+def test_multiworker_through_native_queue():
+    from paddle_tpu.io.native_queue import native_available
+
+    dl = DataLoader(_Range(64), batch_size=8, num_workers=2, shuffle=False,
+                    use_buffer_reader=False)
+    it = iter(dl)
+    if native_available():
+        # the native path actually engaged
+        assert it.it._native_q is not None
+    batches = list(it)
+    assert len(batches) == 8
+    xs = np.concatenate([b[0].numpy() for b in batches])
+    # in-order, exactly-once delivery
+    np.testing.assert_array_equal(xs[:, 0], np.arange(64, dtype=np.float32))
+
+
+def test_worker_exception_propagates():
+    dl = DataLoader(_Faulty(32), batch_size=8, num_workers=2,
+                    use_buffer_reader=False)
+    with pytest.raises(ValueError, match="poison"):
+        list(iter(dl))
+
+
+def test_shared_memory_disabled_falls_back():
+    dl = DataLoader(_Range(16), batch_size=4, num_workers=1,
+                    use_shared_memory=False, use_buffer_reader=False)
+    it = iter(dl)
+    assert it.it._native_q is None
+    assert len(list(it)) == 4

@@ -1,0 +1,94 @@
+"""Elastic manager + restartable-training tests.
+
+reference analogue: test_fleet_elastic_manager.py (watch-state
+classification) + the restart model of fleet/elastic/manager.py; here the
+resume path is TrainStep checkpoints, verified to continue mid-training.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus, run_elastic)
+
+
+def test_watch_states(tmp_path):
+    mgr = ElasticManager(root=str(tmp_path), rank=0, np_=2, min_np=1,
+                         max_np=2, timeout=60)
+    # nobody alive -> ERROR
+    assert mgr.watch() == ElasticStatus.ERROR
+    # self alive only (np=2, min=1) -> RESTART (degraded but viable)
+    mgr.beat()
+    assert mgr.watch() == ElasticStatus.RESTART
+    # both alive -> HOLD
+    other = ElasticManager(root=str(tmp_path), rank=1, np_=2, min_np=1,
+                           max_np=2, timeout=60)
+    other.beat()
+    assert mgr.watch() == ElasticStatus.HOLD
+    assert mgr.alive_workers() == [0, 1]
+    # completion marker wins
+    mgr.mark_completed()
+    assert mgr.watch() == ElasticStatus.COMPLETED
+
+
+def test_stale_heartbeat_detected(tmp_path):
+    mgr = ElasticManager(root=str(tmp_path), rank=0, np_=1, min_np=1,
+                         max_np=1, timeout=0.0)      # everything is stale
+    mgr.beat()
+    assert mgr.alive_workers() == []
+    assert mgr.watch() == ElasticStatus.ERROR
+
+
+def test_run_elastic_resumes_from_checkpoint(tmp_path):
+    from paddle_tpu.jit.to_static import TrainStep
+
+    ckpt = str(tmp_path / "ck.pkl")
+    mgr = ElasticManager(root=str(tmp_path / "hb"), rank=0, np_=1,
+                         min_np=1, max_np=1)
+    crash_at = {"step": 4}
+    seen = {"resumes": [], "steps": []}
+
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (8,)).astype(np.int64)
+
+    def train(resume):
+        seen["resumes"].append(resume is not None)
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        step = TrainStep(model, lambda l, a, b: F.cross_entropy(l(a), b),
+                         paddle.optimizer.Adam(
+                             learning_rate=1e-2,
+                             parameters=model.parameters()))
+        if resume:
+            step.load(resume)
+        while step.step_count < 8:
+            loss = float(step(x, y))
+            seen["steps"].append(step.step_count)
+            step.save(ckpt)
+            if step.step_count == crash_at["step"] and crash_at["step"]:
+                crash_at["step"] = 0          # crash exactly once
+                raise RuntimeError("injected worker failure")
+        return float(loss)
+
+    final = run_elastic(train, ckpt, max_restarts=2, manager=mgr)
+    assert np.isfinite(final)
+    # first attempt cold, second resumed from the step-4 checkpoint
+    assert seen["resumes"] == [False, True]
+    assert seen["steps"] == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def test_run_elastic_gives_up_after_max_restarts(tmp_path):
+    mgr = ElasticManager(root=str(tmp_path / "hb"), rank=0, np_=1,
+                         min_np=1, max_np=1)
+
+    def always_fail(resume):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_elastic(always_fail, str(tmp_path / "none.pkl"),
+                    max_restarts=1, manager=mgr)
