@@ -68,6 +68,10 @@ from . import incubate  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401
+from . import linalg  # noqa: F401
+from . import distribution  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

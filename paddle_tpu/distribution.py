@@ -1,0 +1,183 @@
+"""Probability distributions.
+
+reference parity: python/paddle/distribution.py — Distribution(:42),
+Uniform(:169), Normal(:391), Categorical(:641) with
+sample/entropy/log_prob/probs/kl_divergence and tensor-or-scalar
+parameter broadcasting.
+
+TPU-native: parameters live as Tensors, sampling draws keys from the
+global generator (trace-scoped keys under jit via make_rng), and every
+density computation is a tape-aware jnp composition.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.random import make_rng
+from .core.tensor import Tensor, apply
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_tensor(v, dtype=jnp.float32):
+    """Keep Tensor params on the tape (grads flow to loc/scale/logits);
+    wrap scalars/arrays as constant Tensors."""
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(jnp.asarray(v, dtype))
+
+
+def _arr(v, dtype=jnp.float32):
+    return v._data.astype(dtype) if isinstance(v, Tensor) \
+        else jnp.asarray(v, dtype)
+
+
+class Distribution:
+    """Abstract base (reference: distribution.py:42)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference: distribution.py:169)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+
+    def sample(self, shape: Sequence[int] = (), seed=0):
+        key = jax.random.key(seed) if seed else make_rng("distribution")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.low._data.shape, self.high._data.shape)
+        u = jax.random.uniform(key, shape)
+        # reparameterized: grads flow to low/high through the tape
+        return apply(lambda lo, hi: lo + u * (hi - lo), self.low, self.high,
+                     name="uniform_sample")
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply(f, value, self.low, self.high,
+                     name="uniform_log_prob")
+
+    def probs(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, 1.0 / (hi - lo), 0.0)
+        return apply(f, value, self.low, self.high, name="uniform_probs")
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                     name="uniform_entropy")
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference: distribution.py:391)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def sample(self, shape: Sequence[int] = (), seed=0):
+        key = jax.random.key(seed) if seed else make_rng("distribution")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape)
+        z = jax.random.normal(key, shape)
+        # reparameterization trick: pathwise grads to loc/scale
+        return apply(lambda mu, sig: mu + z * sig, self.loc, self.scale,
+                     name="normal_sample")
+
+    def log_prob(self, value):
+        def f(v, mu, sig):
+            var = sig * sig
+            return (-((v - mu) ** 2) / (2.0 * var)
+                    - jnp.log(sig) - 0.5 * math.log(2.0 * math.pi))
+        return apply(f, value, self.loc, self.scale,
+                     name="normal_log_prob")
+
+    def probs(self, value):
+        def f(v, mu, sig):
+            var = sig * sig
+            return jnp.exp(-((v - mu) ** 2) / (2.0 * var)) / \
+                jnp.sqrt(2.0 * math.pi * var)
+        return apply(f, value, self.loc, self.scale, name="normal_probs")
+
+    def entropy(self):
+        return apply(
+            lambda mu, sig: (0.5 + 0.5 * math.log(2.0 * math.pi)
+                             + jnp.log(sig) + jnp.zeros_like(mu)),
+            self.loc, self.scale, name="normal_entropy")
+
+    def kl_divergence(self, other: "Normal"):
+        """KL(self || other) (reference: distribution.py:596)."""
+        def f(mu0, sig0, mu1, sig1):
+            var_ratio = (sig0 / sig1) ** 2
+            t1 = ((mu0 - mu1) / sig1) ** 2
+            return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+        return apply(f, self.loc, self.scale, other.loc, other.scale,
+                     name="normal_kl")
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference:
+    distribution.py:641 — parameterized by ``logits``, probs derived)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+
+    def sample(self, shape: Sequence[int] = (), seed=0):
+        key = jax.random.key(seed) if seed else make_rng("distribution")
+        logits = self.logits._data
+        return Tensor(jax.random.categorical(
+            key, logits, shape=tuple(shape) + logits.shape[:-1]))
+
+    def entropy(self):
+        def f(lg):
+            p = jax.nn.softmax(lg, axis=-1)
+            return -jnp.sum(p * jax.nn.log_softmax(lg, axis=-1), axis=-1)
+        return apply(f, self.logits, name="categorical_entropy")
+
+    def kl_divergence(self, other: "Categorical"):
+        def f(lg, lh):
+            p = jax.nn.softmax(lg, axis=-1)
+            return jnp.sum(p * (jax.nn.log_softmax(lg, axis=-1)
+                                - jax.nn.log_softmax(lh, axis=-1)), axis=-1)
+        return apply(f, self.logits, other.logits, name="categorical_kl")
+
+    @staticmethod
+    def _gather(table, ids):
+        if table.ndim == 1:                  # single distribution, any batch
+            return table[ids]
+        return jnp.take_along_axis(table, ids[..., None], axis=-1)[..., 0]
+
+    def probs(self, value):
+        ids = _arr(value, jnp.int32)
+        return apply(
+            lambda lg: self._gather(jax.nn.softmax(lg, axis=-1), ids),
+            self.logits, name="categorical_probs")
+
+    def log_prob(self, value):
+        ids = _arr(value, jnp.int32)
+        return apply(
+            lambda lg: self._gather(jax.nn.log_softmax(lg, axis=-1), ids),
+            self.logits, name="categorical_log_prob")

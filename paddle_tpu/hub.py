@@ -1,0 +1,67 @@
+"""paddle.hub: load models/entrypoints from a hubconf.py.
+
+reference parity: python/paddle/hub.py — list/help/load over github/gitee
+/local sources. This environment has no egress, so remote sources raise
+with a clear message; the LOCAL source (a directory containing
+hubconf.py, the dominant intra-org use) is fully supported.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local'")
+    return _load_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False):
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate entrypoint ``model`` from the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no callable entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

@@ -1,0 +1,12 @@
+"""paddle.linalg namespace (reference parity: python/paddle/linalg.py —
+re-exports of tensor.linalg). All ops are tape-aware jnp.linalg wraps."""
+
+from .tensor.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, inv, lstsq, lu, matrix_power, matrix_rank, multi_dot, norm,
+    pinv, qr, slogdet, solve, svd, triangular_solve)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det",
+           "eig", "eigh", "eigvals", "eigvalsh", "inv", "lstsq", "lu",
+           "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+           "slogdet", "solve", "svd", "triangular_solve"]

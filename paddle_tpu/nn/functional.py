@@ -53,6 +53,8 @@ __all__ = [
     # misc
     "cosine_similarity", "label_smooth", "sequence_mask", "temporal_shift",
     "class_center_sample", "scaled_dot_product_attention", "sparse_attention",
+    "adaptive_max_pool3d", "max_pool2d_with_index", "max_unpool2d",
+    "pairwise_distance", "hsigmoid_loss",
 ]
 
 
@@ -420,6 +422,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW" or ceil_mode:
+            raise ValueError("return_mask supports NCHW, ceil_mode=False")
+        return max_pool2d_with_index(x, kernel_size, stride, padding)
     return _pool_nd(x, kernel_size, stride, padding, 2, "max", None, data_format, ceil_mode)
 
 
@@ -494,11 +500,160 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d: return_mask not supported; use "
+            "max_pool2d_with_index for pooled indices")
     return _adaptive_pool(x, output_size, 1, "max", "NCW")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d: return_mask not supported; use "
+            "max_pool2d_with_index for pooled indices")
     return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+
+
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d: return_mask not supported; use "
+            "max_pool2d_with_index for pooled indices")
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    """Max pool returning (out, flat per-channel indices) — the mask the
+    reference's max_pool2d(return_mask=True) produces (max_pool_with_index
+    op) and MaxUnPool2D consumes."""
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pads = _norm_tuple(padding, 2)
+
+    def _pool(a):
+        N, C, H, W = a.shape
+        # pad with dtype-min ourselves: patches' implicit padding is ZERO
+        # (would beat negative maxima / corrupt indices), and -inf is out
+        # too — patch extraction is a conv, and -inf * 0 = NaN
+        if pads[0] or pads[1]:
+            neg = jnp.finfo(a.dtype).min if \
+                jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            a = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[0]),
+                            (pads[1], pads[1])), constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st, padding="VALID")
+        oH, oW = patches.shape[2], patches.shape[3]
+        # [N, C*kh*kw, oH, oW] -> [N, C, kh*kw, oH, oW]
+        patches = patches.reshape(N, C, ks[0] * ks[1], oH, oW)
+        local = jnp.argmax(patches, axis=2)          # [N, C, oH, oW]
+        out = jnp.max(patches, axis=2)
+        oh = jnp.arange(oH)[:, None]
+        ow = jnp.arange(oW)[None, :]
+        row = oh * st[0] - pads[0] + local // ks[1]
+        col = ow * st[1] - pads[1] + local % ks[1]
+        idx = (row * W + col).astype(jnp.int32)
+        return out, idx
+
+    return apply(_pool, _t(x), name="max_pool2d_with_index")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d: scatter values at their pooled-from positions
+    (reference: nn/functional/pooling.py max_unpool2d / unpool_op)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pads = _norm_tuple(padding, 2)
+
+    def _unpool(a, idx):
+        N, C, oH, oW = a.shape
+        if output_size is not None:
+            H, W = output_size[-2], output_size[-1]
+        else:
+            H = (oH - 1) * st[0] - 2 * pads[0] + ks[0]
+            W = (oW - 1) * st[1] - 2 * pads[1] + ks[1]
+        flat_vals = a.reshape(N, C, oH * oW)
+        flat_idx = idx.reshape(N, C, oH * oW).astype(jnp.int32)
+        zeros = jnp.zeros((N, C, H * W), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda z, i, v: z.at[i].set(v)))(zeros, flat_idx, flat_vals)
+        return out.reshape(N, C, H, W)
+
+    return apply(_unpool, _t(x), _t(indices), name="max_unpool2d")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last dim (reference:
+    nn/layer/distance.py PairwiseDistance)."""
+
+    def _pd(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            r = jnp.sum((d != 0).astype(a.dtype), axis=-1, keepdims=keepdim)
+        else:
+            r = jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) \
+                ** (1.0 / p)
+        return r
+
+    return apply(_pd, _t(x), _t(y), name="pairwise_distance")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py:312,
+    matrix_bit_code_functor's SimpleCode default tree).
+
+    Default complete-binary-tree coding over num_classes leaves: for class
+    c let v = c + num_classes; at step k the internal node is
+    (v >> (k+1)) - 1 and the sigmoid target bit is (v >> k) & 1; steps run
+    while v >> (k+1) >= 1. weight: [num_classes-1, D], bias:
+    [num_classes-1]. Custom trees pass path_table/path_code
+    [N, L] (padded with -1).
+    """
+    import math as _math
+    L = max(1, int(_math.ceil(_math.log2(max(2, num_classes)))) + 1)
+
+    def _hs(x, lab, w, *rest):
+        b = rest[0] if rest else None
+        lab = lab.astype(jnp.int32).reshape(-1)
+        if path_table is not None:
+            pt_raw = path_table._data if isinstance(path_table, Tensor) \
+                else path_table
+            pc_raw = path_code._data if isinstance(path_code, Tensor) \
+                else path_code
+            pt = jnp.asarray(pt_raw, jnp.int32)
+            pc = jnp.asarray(pc_raw, jnp.float32)
+            valid = (pt >= 0).astype(jnp.float32)
+            idx = jnp.maximum(pt, 0)
+            bits = pc
+        else:
+            v = lab + num_classes
+            ks = jnp.arange(L)
+            anc = v[:, None] >> (ks[None, :] + 1)          # [N, L]
+            valid = (anc >= 1).astype(jnp.float32)
+            idx = jnp.maximum(anc - 1, 0)
+            bits = ((v[:, None] >> ks[None, :]) & 1).astype(jnp.float32)
+        wk = w[idx]                                        # [N, L, D]
+        pre = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                         wk.astype(jnp.float32))
+        if b is not None:
+            pre = pre + b[idx]
+        # bce-with-logits against the code bit; bit=1 -> sigmoid target 1
+        per = jax.nn.softplus(pre) - bits * pre
+        loss = jnp.sum(per * valid, axis=-1, keepdims=True)
+        return loss
+
+    args = [_t(input), _t(label), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(_hs, *args, name="hsigmoid_loss")
 
 
 # ---------------------------------------------------------------------------

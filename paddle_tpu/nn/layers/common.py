@@ -10,6 +10,7 @@ from ..initializer import Constant, KaimingUniform, Normal, Uniform, XavierUnifo
 from ..layer import Layer
 
 __all__ = [
+    "PairwiseDistance",
     "Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout", "Embedding",
     "Flatten", "Pad1D", "Pad2D", "Pad3D", "Upsample", "UpsamplingBilinear2D",
     "UpsamplingNearest2D", "CosineSimilarity", "PixelShuffle", "PixelUnshuffle",
@@ -249,3 +250,16 @@ class Bilinear(Layer):
                 out = out + mb[0]
             return out
         return apply(_bil, *args, name="bilinear")
+
+
+class PairwiseDistance(Layer):
+    """||x - y||_p row-wise (reference: nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)

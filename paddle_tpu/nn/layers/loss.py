@@ -6,6 +6,7 @@ from .. import functional as F
 from ..layer import Layer
 
 __all__ = [
+    "HSigmoidLoss",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
     "CTCLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss", "TripletMarginLoss",
@@ -155,3 +156,25 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree (reference:
+    nn/layer/loss.py HSigmoidLoss:418; custom trees via
+    path_table/path_code at call time is the is_custom variant)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter((num_classes - 1, feature_size))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((num_classes - 1,), is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -6,6 +6,7 @@ from .. import functional as F
 from ..layer import Layer
 
 __all__ = [
+    "AdaptiveMaxPool3D", "MaxUnPool2D",
     "AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
     "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
     "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
@@ -81,3 +82,26 @@ class AdaptiveMaxPool1D(_AdaptivePool):
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     _fn = staticmethod(F.adaptive_max_pool2d)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool3d)
+
+
+class MaxUnPool2D(Layer):
+    """Inverse of MaxPool2D given the pooled indices (reference:
+    nn/layer/pooling.py MaxUnPool2D / unpool_op)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size,
+                              self.data_format)

@@ -19,7 +19,8 @@ from .. import functional as F
 from ..initializer import Uniform
 from ..layer import Layer, LayerList
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+__all__ = ["RNNCellBase", "RNNBase",
+           "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
            "SimpleRNN", "LSTM", "GRU"]
 
 
@@ -325,3 +326,7 @@ class GRU(_RNNBase):
                  time_major=False, dropout=0.0, **kwargs):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout, **kwargs)
+
+
+# public alias (reference: nn/layer/rnn.py RNNBase)
+RNNBase = _RNNBase

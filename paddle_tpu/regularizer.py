@@ -1,0 +1,12 @@
+"""Weight-decay regularizers (paddle.regularizer namespace).
+
+reference parity: python/paddle/regularizer.py — L1Decay/L2Decay passed as
+``weight_decay=`` to optimizers. The classes live with the optimizer (the
+update rule folds the penalty gradient into the same jitted step:
+L2 -> coeff * w, L1 -> coeff * sign(w), optimizer.py _coupled_decay);
+this module is the public namespace alias.
+"""
+
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

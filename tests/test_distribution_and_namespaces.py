@@ -1,0 +1,148 @@
+"""Distribution / linalg / regularizer / hub namespace tests.
+
+reference analogues: test_distribution.py (sample stats, log_prob vs
+scipy-style closed forms, KL), test_regularizer.py, test_hub.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_uniform_sample_and_density():
+    paddle.seed(0)
+    u = Uniform(low=2.0, high=6.0)
+    s = u.sample((5000,)).numpy()
+    assert (s >= 2.0).all() and (s < 6.0).all()
+    assert abs(s.mean() - 4.0) < 0.1
+    np.testing.assert_allclose(
+        u.probs(paddle.to_tensor(np.array([3.0], np.float32))).numpy(),
+        [0.25], rtol=1e-6)
+    assert np.isneginf(
+        u.log_prob(paddle.to_tensor(np.array([7.0], np.float32))).numpy())
+    np.testing.assert_allclose(u.entropy().numpy(), np.log(4.0), rtol=1e-6)
+
+
+def test_normal_density_entropy_kl():
+    n = Normal(loc=1.0, scale=2.0)
+    x = np.array([0.0, 1.0, 3.0], np.float32)
+    got = n.log_prob(paddle.to_tensor(x)).numpy()
+    expect = (-((x - 1.0) ** 2) / 8.0 - np.log(2.0)
+              - 0.5 * np.log(2 * np.pi))
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+    np.testing.assert_allclose(
+        n.entropy().numpy(), 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0),
+        rtol=1e-6)
+    # KL(N0||N1) closed form
+    m = Normal(loc=0.0, scale=1.0)
+    kl = n.kl_divergence(m).numpy()
+    expect_kl = 0.5 * (4.0 + 1.0 - 1.0 - np.log(4.0))
+    np.testing.assert_allclose(kl, expect_kl, rtol=1e-5)
+    paddle.seed(1)
+    s = n.sample((8000,)).numpy()
+    assert abs(s.mean() - 1.0) < 0.1 and abs(s.std() - 2.0) < 0.1
+
+
+def test_categorical():
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    c = Categorical(logits)
+    np.testing.assert_allclose(
+        c.probs(paddle.to_tensor(np.array([2], np.int64))).numpy(), [0.7],
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor(np.array([0], np.int64))).numpy(),
+        [np.log(0.1)], rtol=1e-5)
+    ent = -np.sum([0.1, 0.2, 0.7] * np.log([0.1, 0.2, 0.7]))
+    np.testing.assert_allclose(c.entropy().numpy(), ent, rtol=1e-5)
+    other = Categorical(np.zeros(3, np.float32))       # uniform
+    kl = float(c.kl_divergence(other).numpy())
+    assert kl > 0
+    paddle.seed(2)
+    s = c.sample((4000,)).numpy()
+    assert abs((s == 2).mean() - 0.7) < 0.05
+
+
+def test_distribution_param_gradients():
+    # policy-gradient style: grads must reach loc/scale/logits
+    loc = paddle.to_tensor(np.array([1.0], np.float32))
+    loc.stop_gradient = False
+    scale = paddle.to_tensor(np.array([2.0], np.float32))
+    scale.stop_gradient = False
+    n = Normal(loc, scale)
+    x = paddle.to_tensor(np.array([0.5], np.float32))
+    n.log_prob(x).sum().backward()
+    assert loc.grad is not None and scale.grad is not None
+    # d/dmu log N = (x-mu)/sig^2 = (0.5-1)/4
+    np.testing.assert_allclose(np.asarray(loc.grad._data), [-0.125],
+                               rtol=1e-5)
+    # reparameterized sampling also differentiates
+    loc.clear_gradient()
+    paddle.seed(5)
+    n.sample((16,)).sum().backward()
+    np.testing.assert_allclose(np.asarray(loc.grad._data), [16.0], rtol=1e-5)
+
+    logits = paddle.to_tensor(np.zeros(3, np.float32))
+    logits.stop_gradient = False
+    c = Categorical(logits)
+    c.log_prob(paddle.to_tensor(np.array([1], np.int64))).sum().backward()
+    assert logits.grad is not None
+    np.testing.assert_allclose(np.asarray(logits.grad._data),
+                               [-1 / 3, 2 / 3, -1 / 3], rtol=1e-5)
+
+
+def test_max_pool_mask_with_padding_negative_values():
+    import paddle_tpu.nn.functional as F
+    # all-negative input + padding: zeros must not leak in, indices stay
+    # in-bounds
+    x = -np.ones((1, 1, 4, 4), np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2,
+                             padding=1, return_mask=True)
+    assert (out.numpy() == -1).all()     # zero padding must not leak in
+    m = mask.numpy()
+    assert (m >= 0).all() and (m < 16).all()
+
+
+def test_linalg_namespace():
+    a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    x = paddle.linalg.solve(paddle.to_tensor(spd),
+                            paddle.to_tensor(a[:, :1])).numpy()
+    np.testing.assert_allclose(spd @ x, a[:, :1], rtol=1e-3, atol=1e-3)
+
+
+def test_regularizer_objects_accepted_by_optimizer():
+    from paddle_tpu import nn
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters(),
+                               weight_decay=L2Decay(0.5))
+    x = paddle.to_tensor(np.zeros((2, 4), np.float32))  # zero input:
+    loss = m(x).sum()                                   # data grad = 0
+    loss.backward()
+    w_before = np.asarray(m.weight._data).copy()
+    opt.step()
+    # pure decay: w -= lr * coeff * w
+    np.testing.assert_allclose(np.asarray(m.weight._data),
+                               w_before * (1 - 0.1 * 0.5), rtol=1e-5)
+    assert isinstance(L1Decay(0.1).coeff, float)
+
+
+def test_hub_local_roundtrip(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_mlp(width=8):\n"
+        "    '''A tiny MLP entrypoint.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(width, width)\n")
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    model = paddle.hub.load(str(tmp_path), "tiny_mlp", width=6)
+    assert tuple(model.weight.shape) == (6, 6)
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("some/repo", "x", source="github")

@@ -1,0 +1,100 @@
+"""Tests for the final layer-zoo additions (max-unpool, hsigmoid,
+pairwise distance, adaptive max pool 3d).
+
+reference analogues: test_unpool_op.py, test_hsigmoid_op.py,
+test_pairwise_distance.py, test_adaptive_max_pool3d.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def test_max_pool2d_return_mask_and_unpool_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2,
+                             return_mask=True)
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    assert tuple(mask.shape) == (2, 3, 4, 4)
+    # indices point at the max of each window
+    flat = x.reshape(2, 3, 64)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, mask.numpy().reshape(2, 3, 16), axis=2),
+        out.numpy().reshape(2, 3, 16), rtol=1e-6)
+
+    up = nn.MaxUnPool2D(kernel_size=2, stride=2)(out, mask)
+    assert tuple(up.shape) == (2, 3, 8, 8)
+    # unpooled values land exactly at the argmax positions, zeros elsewhere
+    nz = up.numpy() != 0
+    assert nz.sum() <= 2 * 3 * 16
+    np.testing.assert_allclose(up.numpy().reshape(2, 3, 64).sum(-1),
+                               out.numpy().reshape(2, 3, 16).sum(-1),
+                               rtol=1e-5)
+
+
+def test_adaptive_max_pool3d():
+    x = np.random.RandomState(1).randn(2, 3, 8, 8, 8).astype(np.float32)
+    out = nn.AdaptiveMaxPool3D(output_size=4)(paddle.to_tensor(x))
+    assert tuple(out.shape) == (2, 3, 4, 4, 4)
+    ref = x.reshape(2, 3, 4, 2, 4, 2, 4, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_pairwise_distance_matches_numpy():
+    rng = np.random.RandomState(2)
+    a = rng.randn(5, 7).astype(np.float32)
+    b = rng.randn(5, 7).astype(np.float32)
+    got = nn.PairwiseDistance(p=2.0)(paddle.to_tensor(a),
+                                     paddle.to_tensor(b)).numpy()
+    ref = np.linalg.norm(a - b + 1e-6, axis=-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    got_inf = nn.PairwiseDistance(p=float("inf"))(
+        paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got_inf, np.abs(a - b + 1e-6).max(-1),
+                               rtol=1e-5)
+
+
+def test_hsigmoid_loss_shapes_and_training():
+    paddle.seed(3)
+    N, D, C = 8, 16, 10
+    layer = nn.HSigmoidLoss(feature_size=D, num_classes=C)
+    x = paddle.to_tensor(np.random.RandomState(4).randn(N, D)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(5).randint(0, C, (N,))
+                         .astype(np.int64))
+    loss = layer(x, y)
+    assert tuple(loss.shape) == (N, 1)
+    assert np.isfinite(loss.numpy()).all()
+
+    # trains: same-class inputs should drive their path loss down
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    xf = paddle.to_tensor(np.ones((4, D), np.float32))
+    yf = paddle.to_tensor(np.zeros((4,), np.int64))
+    first = None
+    for _ in range(30):
+        loss = layer(xf, yf).mean()
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first * 0.3, (first, float(loss))
+
+
+def test_hsigmoid_custom_path():
+    # two-class custom tree: one internal node, code bit = class id
+    N, D = 4, 8
+    layer = nn.HSigmoidLoss(feature_size=D, num_classes=2)
+    x = paddle.to_tensor(np.random.RandomState(6).randn(N, D)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+    pt = np.zeros((N, 1), np.int64)            # all through node 0
+    pc = np.array([[0], [1], [0], [1]], np.float32)
+    loss = layer(x, y, path_table=pt, path_code=pc)
+    assert tuple(loss.shape) == (N, 1)
+    assert np.isfinite(loss.numpy()).all()
