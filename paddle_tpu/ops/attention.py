@@ -46,8 +46,6 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
 
 
 def _flash_supported(q, k, v, mask, dropout_p) -> bool:
-    if dropout_p > 0.0:
-        return False
     if mask is not None:
         # only additive key-padding masks [B, 1, 1, Sk] fit the kernel
         if (mask.dtype == jnp.bool_ or mask.ndim != 4
@@ -68,7 +66,9 @@ def sdpa_array(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
     """Raw-array scaled dot-product attention with flash dispatch."""
     if use_flash and _flash_supported(q, k, v, mask, dropout_p):
         from .pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, bias=mask, causal=is_causal)
+        return flash_attention(q, k, v, bias=mask, causal=is_causal,
+                               dropout_rate=dropout_p,
+                               dropout_key=dropout_key)
     return _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key)
 
 

@@ -74,6 +74,40 @@ def _causal_mask(s, qi, ki, block_q, block_k, off):
     return jnp.where(rows + off >= cols, s, NEG_INF)
 
 
+def _dropout_keep(seed_ref, b, h, qi, ki, shape, rate):
+    """Deterministic keep mask scaled by 1/(1-rate).
+
+    A STATELESS counter-based hash (murmur3 finalizer) over the absolute
+    (batch, head, query-row, key-col) coordinates + the step seed: the
+    backward kernels RE-GENERATE the identical mask instead of storing S^2
+    bits — the dropout analogue of flash's no-residual rematerialization
+    (reference's fused attention stores its uint8 mask, fmha_ref.h). A
+    pure function of indices is bit-reproducible across the fwd/dq/dkv
+    kernels by construction, which Mosaic's stateful hardware PRNG is not.
+    """
+    bq, bk = shape
+    rows = (qi * bq + jax.lax.broadcasted_iota(jnp.int32, shape, 0)) \
+        .astype(jnp.uint32)
+    cols = (ki * bk + jax.lax.broadcasted_iota(jnp.int32, shape, 1)) \
+        .astype(jnp.uint32)
+    bh = (b.astype(jnp.uint32) * jnp.uint32(0xAC564B05)
+          + h.astype(jnp.uint32) * jnp.uint32(19349663))
+    x = (rows * jnp.uint32(0x9E3779B1)
+         ^ cols * jnp.uint32(0x85EBCA6B)
+         ^ bh
+         ^ seed_ref[0].astype(jnp.uint32)
+         ^ (seed_ref[1].astype(jnp.uint32) << 1))
+    # murmur3 fmix32
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(rate, 0.999999) * 4294967296.0)
+    keep = x >= thresh
+    return keep.astype(jnp.float32) / (1.0 - rate)
+
+
 def _dot(a, b, dims, cd=jnp.float32):
     """MXU matmul: operands cast to the policy dtype, f32 accumulation."""
     return jax.lax.dot_general(a.astype(cd), b.astype(cd), (dims, ((), ())),
@@ -85,9 +119,10 @@ def _dot(a, b, dims, cd=jnp.float32):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                cd, off):
+                cd, off, rate):
+    b, h = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -117,7 +152,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             p = jnp.where(s == NEG_INF, 0.0, p)
         alpha = jnp.exp(m_prev - shift)                  # [bq, 1] (<= 1)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + _dot(p, v_ref[0, 0],
+        pv = p
+        if rate > 0.0:
+            # dropout on the normalized probs commutes to masking the pv
+            # accumulation only; the softmax denominator stays undropped
+            pv = p * _dropout_keep(seed_ref, b, h, qi, ki, p.shape, rate)
+        acc_scr[:] = acc_scr[:] * alpha + _dot(pv, v_ref[0, 0],
                                                ((1,), (0,)), cd)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -133,24 +173,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
-def _mk_kernel(kern, has_bias, n_in=3, lse_out=True, **kw):
-    """Adapt ref lists: insert bias_ref=None after the n_in inputs when there
-    is no bias input, and lse_ref=None after the o output when the lse
-    output is dropped (inference)."""
+def _mk_kernel(kern, has_bias, n_in=3, lse_out=True, has_seed=False, **kw):
+    """Adapt ref lists: a leading seed_ref when dropout is on, bias_ref=None
+    inserted after the n_in inputs when there is no bias input, and
+    lse_ref=None after the o output when the lse output is dropped."""
     def wrapped(*refs):
+        if has_seed:
+            seed_ref, refs = refs[0], refs[1:]
+        else:
+            seed_ref = None
         n = n_in + (1 if has_bias else 0)
         ins, rest = list(refs[:n]), list(refs[n:])
         if not has_bias:
             ins = ins[:n_in] + [None] + ins[n_in:]
         if not lse_out:
             rest = rest[:1] + [None] + rest[1:]
-        return kern(*ins, *rest, **kw)
+        return kern(seed_ref, *ins, *rest, **kw)
 
     return wrapped
 
 
 def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
-         save_residuals=True):
+         save_residuals=True, seed=None, rate=0.0):
     """q,k,v: [B, H, S, D]. Returns (o, lse[B, H, S]) — lse is None when
     save_residuals=False (inference: no lse write, saves S*128 f32 HBM
     traffic per (b, h), mirroring the upstream kernel's save_residuals)."""
@@ -160,15 +204,21 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
 
     qs = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     ks = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
-    in_specs = [qs, ks, ks]
-    args = [q, k, v]
+    in_specs = []
+    args = []
+    if rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qs, ks, ks]
+    args += [q, k, v]
     if bias is not None:
         in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                      lambda b, h, i, j: (b, 0, 0, j)))
         args.append(bias)
     kern = _mk_kernel(_fwd_kernel, bias is not None, lse_out=save_residuals,
-                      scale=scale, causal=causal, block_q=block_q,
-                      block_k=block_k, cd=_mxu_dtype(q.dtype), off=Sk - Sq)
+                      has_seed=rate > 0.0, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k,
+                      cd=_mxu_dtype(q.dtype), off=Sk - Sq, rate=rate)
 
     out_specs = [pl.BlockSpec((1, 1, block_q, D),
                               lambda b, h, i, j: (b, h, i, 0))]
@@ -205,8 +255,10 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
-               dq_ref, acc_scr, *, scale, causal, block_q, block_k, cd, off):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+               dlt_ref, dq_ref, acc_scr, *, scale, causal, block_q,
+               block_k, cd, off, rate):
+    b, h = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -229,6 +281,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
         # fully-masked row (lse = NEG_INF): shift by 0 so exp(-1e30) -> 0
         p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))  # [bq, bk]
         dp = _dot(do_ref[0, 0], v_ref[0, 0], ((1,), (1,)), cd)
+        if rate > 0.0:
+            dp = dp * _dropout_keep(seed_ref, b, h, qi, ki, p.shape, rate)
         ds = p * (dp - delta) * scale
         acc_scr[:] += _dot(ds, k_ref[0, 0], ((1,), (0,)), cd)
 
@@ -237,9 +291,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
-                dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr, *, scale,
-                causal, block_q, block_k, cd, off):
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                dlt_ref, dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr, *,
+                scale, causal, block_q, block_k, cd, off, rate):
+    b, h = pl.program_id(0), pl.program_id(1)
     ki, qi = pl.program_id(2), pl.program_id(3)          # k outer, q inner
     nq = pl.num_programs(3)
 
@@ -264,8 +319,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
             s = _causal_mask(s, qi, ki, block_q, block_k, off)
         # fully-masked row (lse = NEG_INF): shift by 0 so exp(-1e30) -> 0
         p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))  # [bq, bk]
-        dv_scr[:] += _dot(p, do_ref[0, 0], ((0,), (0,)), cd)  # p^T dO
+        pv = p
         dp = _dot(do_ref[0, 0], v_ref[0, 0], ((1,), (1,)), cd)
+        if rate > 0.0:
+            # same (b, h, qi, ki) fold as the forward: identical mask
+            keepf = _dropout_keep(seed_ref, b, h, qi, ki, p.shape, rate)
+            pv = p * keepf
+            dp = dp * keepf
+        dv_scr[:] += _dot(pv, do_ref[0, 0], ((0,), (0,)), cd)  # p~^T dO
         ds = p * (dp - delta) * scale
         dk_scr[:] += _dot(ds, q_ref[0, 0], ((0,), (0,)), cd)  # ds^T q
         if db_scr is not None:
@@ -281,18 +342,23 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dlt_ref,
             db_ref[0, 0] = db_scr[:1].astype(db_ref.dtype)
 
 
-def _mk_dkv_kernel(has_bias, **kw):
-    if has_bias:
-        return functools.partial(_dkv_kernel, **kw)
-
-    def wrapped(q, k, v, do, lse, dlt, dk, dv, dk_scr, dv_scr):
-        return _dkv_kernel(q, k, v, None, do, lse, dlt, dk, dv, None,
-                           dk_scr, dv_scr, None, **kw)
+def _mk_dkv_kernel(has_bias, has_seed=False, **kw):
+    def wrapped(*refs):
+        if has_seed:
+            seed_ref, refs = refs[0], refs[1:]
+        else:
+            seed_ref = None
+        if has_bias:
+            return _dkv_kernel(seed_ref, *refs, **kw)
+        q, k, v, do, lse, dlt, dk, dv, dk_scr, dv_scr = refs
+        return _dkv_kernel(seed_ref, q, k, v, None, do, lse, dlt, dk, dv,
+                           None, dk_scr, dv_scr, None, **kw)
 
     return wrapped
 
 
-def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
+def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
+              seed=None, rate=0.0):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     nq, nk = Sq // block_q, Sk // block_k
@@ -306,8 +372,11 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
     ks_j = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
     rowq = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i, j: (b, h, i, 0))
 
-    dq_in_specs = [qs, ks_j, ks_j]
-    dq_args = [q, k, v]
+    seed_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
+                  if rate > 0.0 else [])
+    seed_args = [seed] if rate > 0.0 else []
+    dq_in_specs = seed_specs + [qs, ks_j, ks_j]
+    dq_args = seed_args + [q, k, v]
     if bias is not None:
         dq_in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                         lambda b, h, i, j: (b, 0, 0, j)))
@@ -316,9 +385,10 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
     dq_args += [do, lse_t, dlt_t]
 
     dq = pl.pallas_call(
-        _mk_kernel(_dq_kernel, bias is not None, scale=scale,
-                   causal=causal, block_q=block_q, block_k=block_k,
-                   cd=_mxu_dtype(q.dtype), off=Sk - Sq),
+        _mk_kernel(_dq_kernel, bias is not None, has_seed=rate > 0.0,
+                   scale=scale, causal=causal, block_q=block_q,
+                   block_k=block_k, cd=_mxu_dtype(q.dtype), off=Sk - Sq,
+                   rate=rate),
         grid=(B, H, nq, nk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
@@ -336,8 +406,8 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
     ks_i = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0))
     rowq_j = pl.BlockSpec((1, 1, block_q, 128),
                           lambda b, h, i, j: (b, h, j, 0))
-    dkv_in_specs = [qs_j, ks_i, ks_i]
-    dkv_args = [q, k, v]
+    dkv_in_specs = seed_specs + [qs_j, ks_i, ks_i]
+    dkv_args = seed_args + [q, k, v]
     if bias is not None:
         dkv_in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                          lambda b, h, i, j: (b, 0, 0, i)))
@@ -366,9 +436,9 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
         dkv_scratch.append(pltpu.VMEM((8, block_k), jnp.float32))
 
     outs = pl.pallas_call(
-        _mk_dkv_kernel(bias is not None, scale=scale,
+        _mk_dkv_kernel(bias is not None, has_seed=rate > 0.0, scale=scale,
                        causal=causal, block_q=block_q, block_k=block_k,
-                       cd=_mxu_dtype(q.dtype), off=Sk - Sq),
+                       cd=_mxu_dtype(q.dtype), off=Sk - Sq, rate=rate),
         grid=(B, H, nk, nq),
         in_specs=dkv_in_specs,
         out_specs=dkv_out_specs,
@@ -392,25 +462,34 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k):
+def _seed_arr(seed_f):
+    """f32-bitcast seed words back to int32 (seed travels as a float arg so
+    the custom_vjp can hand back a plain zero cotangent)."""
+    return jax.lax.bitcast_convert_type(seed_f, jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, seed_f, scale, causal, block_q, block_k, rate):
     o, _ = _fwd(q, k, v, bias, scale, causal, block_q, block_k,
-                save_residuals=False)
+                save_residuals=False, seed=_seed_arr(seed_f), rate=rate)
     return o
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return o, (q, k, v, bias, o, lse)
+def _flash_fwd(q, k, v, bias, seed_f, scale, causal, block_q, block_k,
+               rate):
+    o, lse = _fwd(q, k, v, bias, scale, causal, block_q, block_k,
+                  seed=_seed_arr(seed_f), rate=rate)
+    return o, (q, k, v, bias, seed_f, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, bias, o, lse = res
+def _flash_bwd(scale, causal, block_q, block_k, rate, res, do):
+    q, k, v, bias, seed_f, o, lse = res
     dq, dk, dv, db = _bwd_impl(q, k, v, bias, o, lse, do, scale, causal,
-                               block_q, block_k)
+                               block_q, block_k, seed=_seed_arr(seed_f),
+                               rate=rate)
     if bias is not None:
         db = db.astype(bias.dtype)
-    return dq, dk, dv, db
+    return dq, dk, dv, db, jnp.zeros_like(seed_f)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -432,11 +511,16 @@ def _pick_block(seq_len: int, requested: int) -> int:
 def flash_attention(q, k, v, bias=None, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK):
+                    block_k: int = DEFAULT_BLOCK,
+                    dropout_rate: float = 0.0, dropout_key=None):
     """Flash attention over [B, S, H, D] inputs (framework layout).
 
     bias: optional additive mask broadcastable to [B, 1, 1, Sk]
-    (e.g. key padding: 0 keep, -1e30 masked). Returns [B, S, H, D].
+    (e.g. key padding: 0 keep, -1e30 masked).
+    dropout_rate/dropout_key: in-kernel attention dropout via a stateless
+    counter-based hash (works on TPU and in the interpreter); masks are
+    regenerated from the seed in the backward, nothing is stored.
+    Returns [B, S, H, D].
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -447,9 +531,22 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     if bias is not None:
         bias = jnp.broadcast_to(jnp.asarray(bias, jnp.float32),
                                 (B, 1, 1, Sk))
+    rate = float(dropout_rate)
+    if rate >= 1.0:
+        # everything dropped: defined all-zeros output (matches the XLA
+        # composition); avoids 0/0 from the 1/(1-rate) scaling
+        return jnp.zeros_like(q)
+    if rate > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_rate > 0 needs dropout_key")
+        words = jax.random.key_data(dropout_key).ravel()[:2]
+        seed_f = jax.lax.bitcast_convert_type(
+            words.astype(jnp.uint32), jnp.float32)
+    else:
+        seed_f = jnp.zeros((2,), jnp.float32)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash(qt, kt, vt, bias, float(scale), bool(causal),
-               int(block_q), int(block_k))
+    o = _flash(qt, kt, vt, bias, seed_f, float(scale), bool(causal),
+               int(block_q), int(block_k), rate)
     return jnp.swapaxes(o, 1, 2)

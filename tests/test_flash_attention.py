@@ -158,6 +158,93 @@ def test_bf16_inputs():
                                np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+def _host_keep(S, b, h, rate):
+    """Reconstruct the kernel's stateless dropout mask on the host (same
+    murmur3-finalizer hash over absolute coordinates, uint64 arithmetic)."""
+    rows = np.arange(S, dtype=np.uint64)[:, None]
+    cols = np.arange(S, dtype=np.uint64)[None, :]
+    M = np.uint64(0xFFFFFFFF)
+    bh = (np.uint64(b) * np.uint64(0xAC564B05)
+          + np.uint64(h) * np.uint64(19349663)) & M
+    x = ((rows * np.uint64(0x9E3779B1)) & M) \
+        ^ ((cols * np.uint64(0x85EBCA6B)) & M) ^ bh
+    x &= M
+    x ^= x >> np.uint64(16)
+    x = (x * np.uint64(0x85EBCA6B)) & M
+    x ^= x >> np.uint64(13)
+    x = (x * np.uint64(0xC2B2AE35)) & M
+    x ^= x >> np.uint64(16)
+    thresh = np.uint64(min(rate, 0.999999) * 4294967296.0)
+    return (x >= thresh).astype(np.float32) / (1.0 - rate)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [256, 128])
+def test_dropout_matches_host_mask_reference(causal, block):
+    # in-kernel dropout (stateless hash) vs a pure-JAX reference using the
+    # reconstructed mask: forward AND analytic grads must agree — this is
+    # the fwd/bwd mask-consistency proof (backward REGENERATES the mask).
+    # block=128 gives a 2x2 tile grid, exercising the transposed dkv grid
+    # and the per-tile coordinate mixing; B=2 exercises the batch fold.
+    from paddle_tpu.ops.pallas.flash_attention import _flash
+
+    Bv, Sv, Hv, Dv = 2, 256, 2, 64
+    rate = 0.3
+    rng = np.random.RandomState(12)
+    mk = lambda: jnp.swapaxes(jnp.asarray(  # noqa: E731
+        rng.randn(Bv, Sv, Hv, Dv).astype(np.float32)) * 0.3, 1, 2)
+    q, k, v = mk(), mk(), mk()
+    seed_f = jnp.zeros((2,), jnp.float32)
+    keep = jnp.asarray(np.stack([np.stack(
+        [_host_keep(Sv, b, h, rate) for h in range(Hv)])
+        for b in range(Bv)]))
+    G = jnp.asarray(rng.randn(Bv, Hv, Sv, Dv).astype(np.float32))
+    cm = jnp.tril(jnp.ones((Sv, Sv), bool))
+
+    def ref_loss(q_, k_, v_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * 0.125
+        if causal:
+            s = jnp.where(cm[None, None], s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p * keep, v_) * G)
+
+    def kern_loss(q_, k_, v_):
+        return jnp.sum(_flash(q_, k_, v_, None, seed_f, 0.125, causal,
+                              block, block, rate) * G)
+
+    o_k = _flash(q, k, v, None, seed_f, 0.125, causal, block, block, rate)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+    if causal:
+        s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    o_r = jnp.einsum("bhqk,bhkd->bhqd", p * keep, v)
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r),
+                               atol=2e-5, rtol=2e-5)
+
+    g_k = jax.grad(kern_loss, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_k, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_dropout_public_api_guards():
+    q = jnp.asarray(np.random.RandomState(0)
+                    .randn(1, 256, 2, 64).astype(np.float32))
+    # missing key raises on every backend (interpret path works too)
+    with pytest.raises(ValueError, match="dropout_key"):
+        flash_attention(q, q, q, dropout_rate=0.5)
+    # rate >= 1: defined all-zeros output (XLA-fallback parity), no NaN
+    out = flash_attention(q, q, q, dropout_rate=1.0,
+                          dropout_key=jax.random.key(0))
+    assert float(jnp.abs(out).max()) == 0.0
+    # dropout through the public API runs in interpret mode as well
+    out = flash_attention(q, q, q, dropout_rate=0.5,
+                          dropout_key=jax.random.key(0))
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_jit_and_under_trainstep_shapes():
     q, k, v = _qkv(7)
     jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
