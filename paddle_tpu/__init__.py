@@ -11,6 +11,18 @@ Public namespace mirrors `paddle.*`.
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# Production RNG policy: rbg keys — dropout mask generation is ~10x cheaper
+# than threefry on TPU and the reference makes no counter-stream promises.
+# Respect an explicit user/env override.
+import os as _os
+if "JAX_DEFAULT_PRNG_IMPL" not in _os.environ:
+    try:
+        _jax.config.update("jax_default_prng_impl", "rbg")
+    except Exception:
+        pass
+
 from .core import (  # noqa: F401
     CPUPlace,
     CUDAPlace,
@@ -72,6 +84,7 @@ from . import linalg  # noqa: F401
 from . import distribution  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
+from . import utils  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -343,3 +343,80 @@ def gpt2_medium(**kw) -> GPTConfig:
              max_position_embeddings=1024)
     d.update(kw)
     return GPTConfig(**d)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel GPT (BASELINE config 4: GPT-2 345M PP + TP)
+# ---------------------------------------------------------------------------
+
+
+class _GPTEmbeddingStage(Layer):
+    """Embedding front of the pipeline: ids -> hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size)
+        self.word_embeddings.weight._data = Normal(
+            0.0, cfg.initializer_range)(
+            (cfg.vocab_size, cfg.hidden_size), "float32")
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.position_embeddings.weight._data = Normal(
+            0.0, cfg.initializer_range)(
+            (cfg.max_position_embeddings, cfg.hidden_size), "float32")
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        from ..tensor.creation import arange
+        S = input_ids.shape[1]
+        pos = arange(0, S, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        return self.dropout(x)
+
+
+def gpt_pipeline_descs(cfg: GPTConfig):
+    """LayerDesc list for PipelineLayer: embedding | N blocks | tied head
+    (reference: the model-zoo GPTForPretrainingPipe built on
+    fleet/meta_parallel/parallel_layers/pp_layers.py LayerDesc/
+    SharedLayerDesc with shared embedding between first/last stage)."""
+    from ..distributed.meta_parallel.parallel_layers.pp_layers import (
+        LayerDesc, SharedLayerDesc)
+
+    def embed_fwd(shared, ids):
+        return shared(ids)
+
+    def head_fwd(shared, hidden):
+        # tied LM head: project onto the stage-0 embedding table (the
+        # final LayerNorm is its own desc just before this one)
+        return parallel_logits(hidden, shared.word_embeddings.weight)
+
+    descs = [
+        SharedLayerDesc("gpt_embed", _GPTEmbeddingStage,
+                        forward_func=embed_fwd, cfg=cfg),
+    ]
+    descs += [LayerDesc(GPTDecoderLayer, cfg) for _ in range(cfg.num_layers)]
+    descs.append(LayerDesc(LayerNorm, cfg.hidden_size))
+    descs.append(SharedLayerDesc("gpt_embed", _GPTEmbeddingStage,
+                                 forward_func=head_fwd, cfg=cfg))
+    return descs
+
+
+def build_gpt_pipe(cfg: GPTConfig, num_stages: int, accumulate_steps: int = 1,
+                   seg_method: str = "uniform"):
+    """GPT as a PipelineParallel engine (PP outer, TP inner via the
+    vocab/column/row-parallel layers inside each desc)."""
+    from ..distributed.meta_parallel.parallel_layers.pp_layers import (
+        PipelineLayer)
+    from ..distributed.meta_parallel.pipeline_parallel import (
+        PipelineParallel)
+
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(logits, labels):
+        return crit(logits, labels)
+
+    pl_layer = PipelineLayer(gpt_pipeline_descs(cfg), num_stages=num_stages,
+                             loss_fn=loss_fn, seg_method=seg_method)
+    return PipelineParallel(pl_layer, accumulate_steps=accumulate_steps)

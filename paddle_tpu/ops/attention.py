@@ -45,7 +45,12 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, dropout_key):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=prec)
 
 
-def _flash_supported(q, k, v, mask, dropout_p) -> bool:
+def _flash_supported(q, k, v, mask, dropout_p, dropout_key=None) -> bool:
+    if dropout_p > 0.0 and dropout_key is None:
+        # no key: the XLA path silently skips dropout — keep that behavior
+        # shape-independent rather than raising only on flash-eligible
+        # shapes
+        return False
     if mask is not None:
         # only additive key-padding masks [B, 1, 1, Sk] fit the kernel
         if (mask.dtype == jnp.bool_ or mask.ndim != 4
@@ -64,7 +69,8 @@ def _flash_supported(q, k, v, mask, dropout_p) -> bool:
 def sdpa_array(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
                dropout_key=None, use_flash=True):
     """Raw-array scaled dot-product attention with flash dispatch."""
-    if use_flash and _flash_supported(q, k, v, mask, dropout_p):
+    if use_flash and _flash_supported(q, k, v, mask, dropout_p,
+                                      dropout_key):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, bias=mask, causal=is_causal,
                                dropout_rate=dropout_p,

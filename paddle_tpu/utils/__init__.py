@@ -1,0 +1,3 @@
+"""paddle.utils namespace (reference parity: python/paddle/utils)."""
+
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,174 @@
+"""Custom-operator extension: C++ host ops + python-level op registration.
+
+reference parity: python/paddle/utils/cpp_extension/cpp_extension.py:51
+(setup/load compiling user C++ into loadable ops) and the PD_BUILD_OP
+macro story (extension/include/ext_op_meta_info.h:501; example
+tests/custom_op/custom_relu_op.cc).
+
+TPU-native redesign: the accelerator compute path for custom kernels is
+Pallas (`register_op` takes any jnp/pallas callable + optional VJP and
+returns a tape-aware Tensor op — no C++ needed for device code). C++
+remains first-class for HOST ops (pre/post-processing, lookups): `load`
+compiles the source with g++ into a shared library and binds exported
+symbols through `jax.pure_callback`, so the op works inside jit (the
+callback runs host-side, XLA streams the data — the TPU analogue of the
+reference's CPU custom kernels).
+
+C symbol convention (the reference example shape, custom_relu_op.cc):
+    void <name>(const float* x, float* y, int64_t n);            // fwd
+    void <name>_grad(const float* x, const float* gy,
+                     float* gx, int64_t n);                      // bwd
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, _is_tracer, apply
+
+__all__ = ["register_op", "load", "CppExtension"]
+
+
+def register_op(name: str, fn: Callable, vjp: Optional[Callable] = None):
+    """Register a python/Pallas custom operator.
+
+    fn(*arrays) -> array; vjp(primals, cotangent) -> tuple of input
+    cotangents. Returns a callable over Tensors that participates in
+    eager autograd and jit (the analogue of PD_BUILD_OP +
+    PD_BUILD_GRAD_OP).
+    """
+    if vjp is not None:
+        @jax.custom_vjp
+        def core(*args):
+            return fn(*args)
+
+        def fwd(*args):
+            return fn(*args), args
+
+        def bwd(res, g):
+            out = vjp(res, g)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        core.defvjp(fwd, bwd)
+    else:
+        core = fn
+
+    def op(*tensors):
+        ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+              for t in tensors]
+        return apply(core, *ts, name=name)
+
+    op.__name__ = name
+    return op
+
+
+class CppExtension:
+    """A compiled host-op library; exported symbols become Tensor ops."""
+
+    def __init__(self, lib_path: str, functions: Sequence[str]):
+        self._lib = ctypes.CDLL(lib_path)
+        self.lib_path = lib_path
+        for fname in functions:
+            setattr(self, fname, self._bind(fname))
+
+    def _c_fn(self, symbol):
+        f = getattr(self._lib, symbol)
+        f.restype = None
+        return f
+
+    def _bind(self, fname: str):
+        fwd_c = self._c_fn(fname)
+        try:
+            grad_c = self._c_fn(fname + "_grad")
+        except AttributeError:
+            grad_c = None
+
+        def host_fwd(x):
+            x = np.ascontiguousarray(x, np.float32)
+            y = np.empty_like(x)
+            fwd_c(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  ctypes.c_int64(x.size))
+            return y
+
+        def host_bwd(x, gy):
+            x = np.ascontiguousarray(x, np.float32)
+            gy = np.ascontiguousarray(gy, np.float32)
+            gx = np.empty_like(x)
+            grad_c(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   gy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   gx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   ctypes.c_int64(x.size))
+            return gx
+
+        def fwd_arr(x):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x)
+
+        def vjp_arr(primals, g):
+            (x,) = primals
+            gx = jax.pure_callback(
+                host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, g)
+            return (gx,)
+
+        traced_op = (register_op(fname, fwd_arr) if grad_c is None
+                     else register_op(fname, fwd_arr, vjp_arr))
+
+        def op(x):
+            from ..core.tensor import (TapeNode, Tensor as T,
+                                       _wrap_outputs, is_grad_enabled)
+            t = x if isinstance(x, T) else T(jnp.asarray(x))
+            if _is_tracer(t._data):
+                # under jit: route through pure_callback (host callbacks —
+                # available on real TPU runtimes)
+                return traced_op(t)
+            # eager: run the C function directly on a host copy; the tape
+            # node calls the _grad symbol directly too — no jax host
+            # callback machinery involved
+            x_np = np.asarray(t._data)
+            out = jnp.asarray(host_fwd(x_np))
+            node = None
+            if grad_c is not None and is_grad_enabled() \
+                    and not t.stop_gradient:
+                def vjp_fn(g, x_np=x_np):
+                    return (jnp.asarray(host_bwd(x_np, np.asarray(g))),)
+                node = TapeNode(vjp_fn, [t],
+                                [jax.ShapeDtypeStruct(out.shape, out.dtype)],
+                                name=fname)
+            return _wrap_outputs(out, node=node)
+
+        op.__name__ = fname
+        return op
+
+
+def load(name: str, sources: Sequence[str], functions: Sequence[str],
+         extra_cxx_cflags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CppExtension:
+    """Compile C++ sources into a host-op extension (reference:
+    cpp_extension.load — JIT build via setuptools; here a direct g++
+    -shared build, no setuptools round trip)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < newest:
+        cmd = ["g++", "-O2", "-shared", "-fPIC", *extra_cxx_cflags,
+               *srcs, "-o", lib_path]
+        if verbose:
+            print(" ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"building extension {name!r} failed:\n{proc.stderr}")
+    return CppExtension(lib_path, functions)

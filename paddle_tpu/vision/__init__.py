@@ -1,1 +1,3 @@
 from . import datasets, models, transforms  # noqa: F401
+
+from . import ops  # noqa: F401

@@ -1,0 +1,253 @@
+"""Vision/detection operators.
+
+reference parity: python/paddle/vision/ops.py — yolo_box(:252),
+roi_align(:1145), roi_pool(:1022), psroi_pool(:911), nms (2.x surface;
+CUDA kernels under operators/detection/). deform_conv2d and the file IO
+ops (read_file/decode_jpeg need libjpeg op kernels) are not ported.
+
+TPU-native notes: NMS is sequential by nature — implemented as a
+fixed-iteration `lax.while_loop`-free greedy scan with static shapes
+(compiles under jit; returns a padded index tensor + count). roi_align is
+a fully vectorized bilinear gather (static sampling grid), the classic
+TPU-friendly formulation of the CUDA kernel's per-bin loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "yolo_box"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _iou_arrays(a, b):
+    """Raw-array pairwise IoU (shared by box_iou and nms)."""
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU of [N, 4] and [M, 4] xyxy boxes -> [N, M]."""
+    return apply(_iou_arrays, _t(boxes1), _t(boxes2), name="box_iou")
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None,
+        name=None):
+    """Greedy non-maximum suppression (reference: vision/ops.py nms /
+    operators/detection/nms_op). Returns kept indices sorted by score.
+
+    Static-shape jit-friendly core: N iterations of suppress-the-rest;
+    category-aware when category_idxs is given (boxes only suppress within
+    their own category, the reference's batched path).
+    """
+    b = _t(boxes)
+    n = b.shape[0]
+    s = _t(scores) if scores is not None else None
+
+    def _nms(bx, *maybe_s):
+        order = (jnp.argsort(-maybe_s[0]) if maybe_s
+                 else jnp.arange(bx.shape[0]))
+        bx_sorted = bx[order]
+        iou = _iou_arrays(bx_sorted, bx_sorted)
+        if category_idxs is not None:
+            cats = jnp.asarray(
+                category_idxs._data if isinstance(category_idxs, Tensor)
+                else category_idxs)[order]
+            same = cats[:, None] == cats[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            # suppress j>i overlapping a KEPT i
+            sup = (iou[i] > iou_threshold) & keep[i] & \
+                (jnp.arange(n) > i)
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+        kept_sorted = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+        idx = jnp.where(kept_sorted >= 0, order[kept_sorted], -1)
+        return idx, jnp.sum(keep)
+
+    args = [b] + ([s] if s is not None else [])
+    idx, count = apply(_nms, *args, name="nms")
+    # eager convenience: trim padding when not tracing
+    try:
+        c = int(np.asarray(count.data))
+        idx = idx[:c]
+    except Exception:
+        pass
+    if top_k is not None:
+        idx = idx[:top_k]
+    return idx
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """RoIAlign (reference: vision/ops.py:1145, roi_align_op.cu): bilinear
+    sampling on a static grid per output bin, averaged."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def _ra(feat, rois):
+        N, C, H, W = feat.shape
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid [R, ph, sr] x [R, pw, sr]
+        iy = (jnp.arange(ph)[None, :, None]
+              + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
+        ys = y1[:, None, None] + iy * bin_h[:, None, None]     # [R, ph, sr]
+        ix = (jnp.arange(pw)[None, :, None]
+              + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
+        xs = x1[:, None, None] + ix * bin_w[:, None, None]     # [R, pw, sr]
+
+        # roi -> batch index from boxes_num
+        bn = jnp.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                         else boxes_num)
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=rois.shape[0])
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [ph, sr]; xx [pw, sr]
+            y = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            y0 = jnp.floor(y).astype(jnp.int32)
+            x0 = jnp.floor(xc).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, H - 1)
+            x1i = jnp.minimum(x0 + 1, W - 1)
+            wy = y - y0
+            wx = xc - x0
+            # gather corners: [C, ph, sr, pw, sr]
+            g = lambda yi, xi: img[:, yi[:, :, None, None],  # noqa: E731
+                                   xi[None, None, :, :]]
+            val = (g(y0, x0) * ((1 - wy)[:, :, None, None]
+                                * (1 - wx)[None, None])
+                   + g(y0, x1i) * ((1 - wy)[:, :, None, None]
+                                   * wx[None, None])
+                   + g(y1i, x0) * (wy[:, :, None, None]
+                                   * (1 - wx)[None, None])
+                   + g(y1i, x1i) * (wy[:, :, None, None] * wx[None, None]))
+            return val.mean(axis=(2, 4))        # avg over samples
+
+        out = jax.vmap(lambda bi, yy, xx: bilinear(feat[bi], yy, xx))(
+            batch_idx, ys, xs)
+        return out                               # [R, C, ph, pw]
+
+    return apply(_ra, _t(x), _t(boxes), name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """RoIPool via max over a dense RoIAlign grid (reference:
+    vision/ops.py:1022). Uses a fine sampling grid + max reduction — the
+    static-shape TPU formulation of the adaptive-bin max."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def _rp(feat, rois):
+        N, C, H, W = feat.shape
+        x1 = jnp.floor(rois[:, 0] * spatial_scale)
+        y1 = jnp.floor(rois[:, 1] * spatial_scale)
+        x2 = jnp.ceil(rois[:, 2] * spatial_scale)
+        y2 = jnp.ceil(rois[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        sr = 4                                   # dense enough per bin
+        ys = (y1[:, None, None]
+              + (jnp.arange(ph)[None, :, None]
+                 + (jnp.arange(sr)[None, None, :]) / sr)
+              * (rh / ph)[:, None, None])
+        xs = (x1[:, None, None]
+              + (jnp.arange(pw)[None, :, None]
+                 + (jnp.arange(sr)[None, None, :]) / sr)
+              * (rw / pw)[:, None, None])
+        bn = jnp.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                         else boxes_num)
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=rois.shape[0])
+
+        def pool(img, yy, xx):
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            vals = img[:, yi[:, :, None, None], xi[None, None, :, :]]
+            return vals.max(axis=(2, 4))
+
+        return jax.vmap(lambda bi, yy, xx: pool(feat[bi], yy, xx))(
+            batch_idx, ys, xs)
+
+    return apply(_rp, _t(x), _t(boxes), name="roi_pool")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox: bool = True, name=None,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5):
+    """Decode YOLOv3 head output into boxes + scores (reference:
+    vision/ops.py:252, yolo_box_op). x: [N, A*(5+cls), H, W]."""
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box: iou_aware heads (extra A iou channels, conf = "
+            "conf^(1-f) * iou^f) are not implemented")
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def _yb(xa, imgs):
+        N, _, H, W = xa.shape
+        pred = xa.reshape(N, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(pred[:, :, 0]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gx) / W
+        by = (sig(pred[:, :, 1]) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gy) / H
+        aw = jnp.asarray(anchors[:, 0])[None, :, None, None]
+        ah = jnp.asarray(anchors[:, 1])[None, :, None, None]
+        input_w = W * downsample_ratio
+        input_h = H * downsample_ratio
+        bw = jnp.exp(pred[:, :, 2]) * aw / input_w
+        bh = jnp.exp(pred[:, :, 3]) * ah / input_h
+        conf = sig(pred[:, :, 4])
+        probs = sig(pred[:, :, 5:]) * conf[:, :, None]
+        im_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * im_w
+        y1 = (by - bh / 2) * im_h
+        x2 = (bx + bw / 2) * im_w
+        y2 = (by + bh / 2) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, im_w - 1)
+            y1 = jnp.clip(y1, 0, im_h - 1)
+            x2 = jnp.clip(x2, 0, im_w - 1)
+            y2 = jnp.clip(y2, 0, im_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) \
+            .reshape(N, A * H * W, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2) \
+            .reshape(N, A * H * W, class_num)
+        mask = (conf.reshape(N, A * H * W) >= conf_thresh)[..., None]
+        return boxes * mask, scores * mask
+
+    return apply(_yb, _t(x), _t(img_size), name="yolo_box")

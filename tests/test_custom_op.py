@@ -1,0 +1,90 @@
+"""Custom-operator extension tests.
+
+reference analogues: tests/custom_op/test_custom_relu_op_setup.py (build
+custom_relu_op.cc, run fwd/bwd vs paddle.nn.functional.relu) and the
+PD_BUILD_OP registration checks.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+RELU_CC = textwrap.dedent("""
+    #include <cstdint>
+    extern "C" {
+    void custom_relu(const float* x, float* y, int64_t n) {
+      for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+    }
+    void custom_relu_grad(const float* x, const float* gy, float* gx,
+                          int64_t n) {
+      for (int64_t i = 0; i < n; ++i) gx[i] = x[i] > 0.f ? gy[i] : 0.f;
+    }
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def relu_ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "custom_relu.cc"
+    src.write_text(RELU_CC)
+    return cpp_extension.load("custom_relu_mod", [str(src)],
+                              functions=["custom_relu"],
+                              build_directory=str(d))
+
+
+def test_cpp_op_forward(relu_ext):
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    y = relu_ext.custom_relu(paddle.to_tensor(x))
+    np.testing.assert_allclose(y.numpy(), np.maximum(x, 0), rtol=1e-6)
+
+
+def test_cpp_op_backward(relu_ext):
+    x = paddle.to_tensor(np.random.RandomState(1).randn(3, 3)
+                         .astype(np.float32))
+    x.stop_gradient = False
+    relu_ext.custom_relu(x).sum().backward()
+    g = np.asarray(x.grad._data)
+    np.testing.assert_allclose(g, (np.asarray(x._data) > 0)
+                               .astype(np.float32), rtol=1e-6)
+
+
+def test_cpp_op_inside_jit(relu_ext):
+    import jax
+    import jax.numpy as jnp
+    # pure_callback keeps the host op usable under jit
+    f = jax.jit(lambda a: relu_ext.custom_relu(
+        paddle.to_tensor(a))._data * 2)
+    out = f(jnp.asarray(np.array([-1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 4.0], rtol=1e-6)
+
+
+def test_register_python_op_with_custom_vjp():
+    import jax.numpy as jnp
+
+    # clipped-square with a deliberately custom gradient (2x everywhere,
+    # ignoring the clip) to prove the custom vjp is used
+    myop = cpp_extension.register_op(
+        "clip_sq",
+        lambda x: jnp.clip(x, -1, 1) ** 2,
+        vjp=lambda primals, g: (2.0 * primals[0] * g,))
+    x = paddle.to_tensor(np.array([0.5, 3.0], np.float32))
+    x.stop_gradient = False
+    y = myop(x)
+    np.testing.assert_allclose(y.numpy(), [0.25, 1.0], rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [1.0, 6.0],
+                               rtol=1e-6)
+
+
+def test_register_op_default_autodiff():
+    import jax.numpy as jnp
+    myop = cpp_extension.register_op("cube", lambda x: x ** 3)
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    myop(x).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [12.0], rtol=1e-6)

@@ -91,6 +91,6 @@ def test_hybrid_tp_zero_on_mesh():
         {(cfg.vocab_size // 2, 32)}
 
     data = _batch(cfg, B=8, seed=4)
-    l0 = float(step(*data))
-    l1 = float(step(*data))
-    assert np.isfinite(l0) and l1 < l0
+    losses = [float(step(*data)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

@@ -170,6 +170,27 @@ def test_scaler_loss_reported_unscaled():
     np.testing.assert_allclose(plain, scaled, rtol=1e-5)
 
 
+def test_gpt_pipeline_with_tied_embedding_converges():
+    # BASELINE config 4 shape at toy scale: GPT via PipelineLayer descs
+    # with the embedding table shared between stage 0 and the LM head
+    from paddle_tpu.models.gpt import build_gpt_pipe, gpt_tiny
+
+    paddle.seed(9)
+    pp = build_gpt_pipe(gpt_tiny(), num_stages=2, accumulate_steps=2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=pp._layers.parameters())
+    rng = np.random.RandomState(0)
+    ids = (np.arange(32)[None, :] + rng.randint(0, 256, (4, 1))) % 256
+    labels = (ids + 1) % 256
+    data = (paddle.to_tensor(ids.astype(np.int32)),
+            paddle.to_tensor(labels.astype(np.int32)))
+    losses = [float(pp.train_batch(data, opt)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    tables = [k for k, _ in pp._layers.named_parameters()
+              if "word_embeddings" in k]
+    assert len(tables) == 1          # tied, not duplicated
+
+
 def test_train_batch_converges():
     paddle.seed(4)
     pl = PipelineLayer(_descs(2), num_stages=2,

@@ -1,0 +1,95 @@
+"""Detection-op tests (reference analogues: test_nms_op.py,
+test_roi_align_op.py, test_yolo_box_op.py, test_iou_similarity_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def test_box_iou_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(6, 4).astype(np.float32) * 100, axis=-1)
+    b = np.sort(rng.rand(4, 4).astype(np.float32) * 100, axis=-1)
+    got = ops.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+
+    def iou(x, y):
+        ax = max(0, min(x[2], y[2]) - max(x[0], y[0]))
+        ay = max(0, min(x[3], y[3]) - max(x[1], y[1]))
+        inter = ax * ay
+        ua = ((x[2] - x[0]) * (x[3] - x[1])
+              + (y[2] - y[0]) * (y[3] - y[1]) - inter)
+        return inter / (ua + 1e-10)
+
+    ref = np.array([[iou(x, y) for y in b] for x in a], np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_nms_greedy_reference():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [21, 21, 31, 31], [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+    idx = np.asarray(ops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                             scores=paddle.to_tensor(scores)).data)
+    # highest scorer of each overlapping cluster survives, sorted by score
+    assert idx.tolist() == [3, 0, 4]
+
+
+def test_nms_category_aware():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int32)           # different categories:
+    idx = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.5,
+                             paddle.to_tensor(scores),
+                             category_idxs=paddle.to_tensor(cats),
+                             categories=[0, 1]).data)
+    assert sorted(idx.tolist()) == [0, 1]       # no cross-category suppress
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every bin averages to the constant
+    feat = np.full((1, 3, 16, 16), 7.0, np.float32)
+    rois = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+    out = ops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                        np.array([2]), output_size=4).numpy()
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    feat = paddle.to_tensor(np.random.RandomState(0)
+                            .randn(1, 2, 8, 8).astype(np.float32))
+    feat.stop_gradient = False
+    rois = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+    out = ops.roi_align(feat, rois, np.array([1]), output_size=2)
+    out.sum().backward()
+    g = np.asarray(feat.grad._data)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 8, 8), np.float32)
+    feat[0, 0, 1, 1] = 5.0
+    feat[0, 0, 6, 6] = 9.0
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = ops.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       np.array([1]), output_size=2).numpy()
+    assert out.max() == 9.0 and out[0, 0, 0, 0] == 5.0
+
+
+def test_yolo_box_shapes_and_range():
+    N, A, cls, H, W = 2, 3, 4, 5, 5
+    x = np.random.RandomState(0).randn(N, A * (5 + cls), H, W) \
+        .astype(np.float32)
+    img = np.tile(np.array([[320, 320]], np.int32), (N, 1))
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img),
+        anchors=[10, 13, 16, 30, 33, 23], class_num=cls,
+        conf_thresh=0.0, downsample_ratio=32)
+    assert tuple(boxes.shape) == (N, A * H * W, 4)
+    assert tuple(scores.shape) == (N, A * H * W, cls)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 320).all()   # clipped to image
+    s = scores.numpy()
+    assert (s >= 0).all() and (s <= 1).all()
