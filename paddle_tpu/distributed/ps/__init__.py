@@ -1,0 +1,154 @@
+"""Parameter-server runtime: host-resident sparse tables.
+
+reference parity: the PS stack (paddle/fluid/distributed/ ~22k LoC C++:
+brpc server/client, SparseTable shards, async push/pull;
+python/paddle/distributed/fleet in PS mode with
+role_maker/init_server/init_worker). Its job: embedding tables far larger
+than accelerator memory, updated sparsely.
+
+TPU-native redesign: on TPU pods the "server" is the host RAM attached to
+every worker (hundreds of GB) — so the PS collapses to an in-process
+host-memory SparseTable with pull (gather rows -> device) and push
+(apply sparse optimizer update host-side), sharded by `id % num_shards`
+across hosts in multi-host jobs (each host owns its shard; cross-host
+traffic uses the same pull/push API). DistributedEmbedding wires the
+pull into forward and the push into the backward tape, so training code
+sees an ordinary Layer while gradients stream back to host memory —
+the reference's async push/pull becomes the natural eager flow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import TapeNode, Tensor, _wrap_outputs, is_grad_enabled
+from ...nn.layer import Layer
+
+__all__ = ["SparseTable", "DistributedEmbedding"]
+
+
+class SparseTable:
+    """Host-memory embedding shard with sparse optimizers.
+
+    reference: fluid/distributed SparseTable + DownpourWorker push/pull;
+    optimizers follow the PS convention (sgd | adagrad, applied row-wise
+    on push).
+    """
+
+    def __init__(self, num_rows: int, dim: int, initializer=None,
+                 optimizer: str = "adagrad", lr: float = 0.05,
+                 shard_id: int = 0, num_shards: int = 1, seed: int = 0):
+        self.num_rows = num_rows
+        self.dim = dim
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        # each shard stores ONLY its rows (ids with id % num_shards ==
+        # shard_id): that is the whole point of sharding a
+        # bigger-than-one-host table
+        self.local_rows = (num_rows + num_shards - 1 - shard_id) \
+            // num_shards
+        rng = np.random.default_rng(seed + shard_id)
+        scale = 1.0 / np.sqrt(dim)
+        self.data = (initializer(self.local_rows, dim)
+                     if initializer is not None
+                     else rng.uniform(-scale, scale,
+                                      (self.local_rows, dim))
+                     .astype(np.float32))
+        self.optimizer = optimizer
+        self.lr = lr
+        if optimizer == "adagrad":
+            self._g2 = np.zeros((self.local_rows,), np.float32)
+        elif optimizer != "sgd":
+            raise ValueError(f"unknown PS optimizer {optimizer!r}")
+        self.pull_count = 0
+        self.push_count = 0
+
+    def _local(self, ids: np.ndarray) -> np.ndarray:
+        if self.num_shards > 1:
+            if not ((ids % self.num_shards) == self.shard_id).all():
+                raise ValueError("ids routed to the wrong shard")
+            return ids // self.num_shards
+        return ids
+
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for ids (reference: pull_sparse)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self.pull_count += 1
+        return self.data[self._local(ids)]
+
+    def push(self, ids, grads) -> None:
+        """Apply a sparse update for ids (reference: push_sparse).
+        Duplicate ids accumulate before the update, matching dense
+        embedding-gradient semantics."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        local = self._local(ids)
+        uniq, inv = np.unique(local, return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        if self.optimizer == "adagrad":
+            self._g2[uniq] += (acc ** 2).mean(axis=1)
+            denom = np.sqrt(self._g2[uniq])[:, None] + 1e-10
+            self.data[uniq] -= self.lr * acc / denom
+        else:
+            self.data[uniq] -= self.lr * acc
+        self.push_count += 1
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        out = {"data": self.data}
+        if self.optimizer == "adagrad":
+            out["g2"] = self._g2
+        return out
+
+    def load_state_dict(self, state):
+        self.data = np.asarray(state["data"], np.float32)
+        if self.optimizer == "adagrad" and "g2" in state:
+            self._g2 = np.asarray(state["g2"], np.float32)
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose table lives in host memory (PS-style).
+
+    forward: host pull -> device array; backward: the tape node pushes the
+    row gradients straight into the SparseTable (fused server update — the
+    reference's async push). The table is NOT a Parameter: dense
+    optimizers skip it, exactly like the reference's PS-mode embeddings.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 table: Optional[SparseTable] = None, lr: float = 0.05,
+                 optimizer: str = "adagrad", name=None):
+        super().__init__()
+        self.table = table or SparseTable(num_embeddings, embedding_dim,
+                                          optimizer=optimizer, lr=lr)
+        self.embedding_dim = embedding_dim
+
+    def forward(self, ids: Tensor) -> Tensor:
+        from ...core.tensor import _is_tracer
+        raw = ids._data if isinstance(ids, Tensor) else ids
+        if _is_tracer(raw):
+            raise RuntimeError(
+                "DistributedEmbedding pulls from HOST memory and is "
+                "eager-only; keep it outside jit/TrainStep (feed its "
+                "output as a batch input), like the reference's PS-mode "
+                "embeddings which live outside the trainer program")
+        ids_np = np.asarray(raw)
+        rows = self.table.pull(ids_np)
+        out = jnp.asarray(rows.reshape(ids_np.shape + (self.embedding_dim,)))
+        node = None
+        if is_grad_enabled():
+            table = self.table
+
+            def vjp_fn(g, ids_np=ids_np):
+                table.push(ids_np, np.asarray(g))
+                return ()                  # no upstream tensors
+
+            node = TapeNode(vjp_fn, [],
+                            [jax.ShapeDtypeStruct(out.shape, out.dtype)],
+                            name="ps_embedding")
+        return _wrap_outputs(out, node=node)

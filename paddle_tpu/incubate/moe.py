@@ -1,0 +1,216 @@
+"""Mixture-of-Experts: expert-parallel primitives + a gated MoE layer.
+
+reference parity: distributed/utils.py global_scatter(:57)/global_gather
+(:151) over the global_scatter/global_gather ops
+(operators/collective/global_scatter_op.cc — all-to-all by per-expert
+counts). The reference ships ONLY those primitives ("ops only, no python
+MoE layer yet", SURVEY §2.3); the MoELayer here completes the story.
+
+TPU-native design: the layer is the GShard formulation — top-k gating,
+fixed expert capacity, dispatch/combine as one-hot einsums — so the whole
+thing is ONE jit-compilable dense program with static shapes. Expert
+weights carry PartitionSpecs over the 'ep' ("expert parallel") mesh axis;
+under a mesh, XLA partitions the expert dimension and inserts the
+all-to-alls the reference's global_scatter performs explicitly. The
+functional global_scatter/global_gather (shard_map + lax.all_to_all) are
+provided for reference-style explicit routing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer, LayerList
+
+__all__ = ["global_scatter", "global_gather", "top2_gating", "ExpertFFN",
+           "MoELayer"]
+
+EP_AXIS = "ep"
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Send rows of ``x`` to experts on other ranks (call inside shard_map
+    over the ep axis; reference: distributed/utils.py:57).
+
+    local_count[i]: rows this rank sends to global expert i;
+    global_count[i]: rows this rank receives for its local experts.
+    Counts must be equal-per-rank (fixed capacity) for the static-shape
+    all-to-all — the GShard capacity discipline.
+    """
+    from jax import lax
+    n = lax.psum(1, EP_AXIS)
+    rows = x.shape[0]
+    if rows % n:
+        raise ValueError(f"rows {rows} must divide ep size {n}")
+    return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference: distributed/utils.py:151)."""
+    from jax import lax
+    return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def top2_gating(logits, capacity: int):
+    """GShard top-2 gating over raw arrays.
+
+    logits: [S, E] -> (combine [S, E, C], dispatch bool [S, E, C],
+    aux_loss). Fixed capacity C per expert; overflow tokens are dropped
+    (their combine weights are zero), the standard TPU-shape discipline.
+    """
+    S, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # top-1
+    idx1 = jnp.argmax(probs, axis=-1)                         # [S]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    # top-2: best of the rest
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    # positions within each expert's capacity (running count per expert)
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1          # [S, E]
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1.0 + mask1.sum(0)) * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = (probs * keep1).sum(-1)                              # [S]
+    g2 = (probs * keep2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jax.nn.one_hot((pos1.sum(-1)).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                  # [S, C]
+    loc2 = jax.nn.one_hot((pos2.sum(-1)).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)
+    combine = (g1[:, None, None] * keep1[:, :, None] * loc1[:, None, :]
+               + g2[:, None, None] * keep2[:, :, None] * loc2[:, None, :])
+    dispatch = combine > 0.0
+
+    # load-balance aux loss (GShard eq.4): E * mean(frac_tokens * frac_prob)
+    frac_tokens = mask1.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return combine, dispatch, aux
+
+
+class ExpertFFN(Layer):
+    """E homogeneous FFN experts as STACKED parameters [E, ...] with
+    P('ep', ...) specs — the GSPMD expert-parallel formulation: a mesh
+    with an 'ep' axis places one expert group per slice and the expert
+    einsum partitions over it (XLA inserts the all-to-alls)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden))
+        self.w1.spec = P(EP_AXIS, None, None)
+        self.b1 = self.create_parameter((num_experts, 1, d_hidden),
+                                        is_bias=True)
+        self.b1.spec = P(EP_AXIS, None, None)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model))
+        self.w2.spec = P(EP_AXIS, None, None)
+        self.b2 = self.create_parameter((num_experts, 1, d_model),
+                                        is_bias=True)
+        self.b2.spec = P(EP_AXIS, None, None)
+        self.activation = activation
+
+    def forward(self, x):
+        """x: [E, C, D] (per-expert capacity slices) -> [E, C, D]."""
+        act = self.activation
+
+        def fn(a, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", a, w1) + b1
+            h = jax.nn.gelu(h) if act is None else act(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return apply(fn, x, self.w1, self.b1, self.w2, self.b2,
+                     name="expert_ffn")
+
+
+class MoELayer(Layer):
+    """Gated mixture of experts (completes the reference's MoE primitives).
+
+    Two expert forms:
+    - ``experts=ExpertFFN(...)`` (or num_experts+d_hidden kwargs): stacked
+      parameters with P('ep', ...) specs — REAL expert parallelism over a
+      mesh 'ep' axis, experts applied in one einsum.
+    - ``experts=[Layer, ...]``: arbitrary heterogeneous experts applied in
+      a python loop; parameters are replicated (no ep sharding) — the
+      flexible single-slice form.
+    `aux_loss` holds the load-balancing term after each call.
+    """
+
+    def __init__(self, d_model: int, experts=None, gate=None,
+                 capacity_factor: float = 2.0, num_experts: int = None,
+                 d_hidden: int = None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        if experts is None:
+            if not (num_experts and d_hidden):
+                raise ValueError("pass experts= or num_experts+d_hidden")
+            experts = ExpertFFN(num_experts, d_model, d_hidden)
+        if isinstance(experts, ExpertFFN):
+            self.experts = experts
+            self.num_experts = experts.num_experts
+            self._stacked = True
+        else:
+            self.experts = experts if isinstance(experts, LayerList) \
+                else LayerList(list(experts))
+            self.num_experts = len(self.experts)
+            self._stacked = False
+        from ..nn.layers.common import Linear
+        self.gate = gate or Linear(d_model, self.num_experts, bias_attr=False)
+        self.capacity_factor = capacity_factor
+        self.aux_loss: Optional[Tensor] = None
+
+    def _capacity(self, tokens: int) -> int:
+        return max(4, int(math.ceil(
+            tokens * self.capacity_factor / self.num_experts)))
+
+    def forward(self, x):
+        B, S, D = x.shape
+        tokens = B * S
+        C = self._capacity(tokens)
+        E = self.num_experts
+
+        flat = x.reshape((tokens, D))
+        logits = self.gate(flat)                              # [T, E]
+
+        def gating(lg):
+            return top2_gating(lg, C)
+
+        combine, dispatch, aux = apply(gating, logits, name="moe_gating")
+        self.aux_loss = aux
+
+        # dispatch: [T, E, C] x [T, D] -> [E, C, D]
+        def dispatch_fn(disp, ff):
+            return jnp.einsum("tec,td->ecd", disp.astype(ff.dtype), ff)
+
+        expert_in = apply(dispatch_fn, dispatch, flat, name="moe_dispatch")
+
+        # each expert on its capacity slice
+        if self._stacked:
+            expert_out = self.experts(expert_in)              # [E, C, D]
+        else:
+            outs = []
+            for e, expert in enumerate(self.experts):
+                outs.append(expert(expert_in[e]))             # [C, D]
+            from ..tensor.manipulation import stack
+            expert_out = stack(outs, axis=0)                  # [E, C, D]
+
+        def combine_fn(comb, eo):
+            return jnp.einsum("tec,ecd->td", comb.astype(eo.dtype), eo)
+
+        out = apply(combine_fn, combine, expert_out, name="moe_combine")
+        return out.reshape((B, S, D))
