@@ -115,6 +115,27 @@ def bench_bert_mlm() -> dict:
             "ms_per_step": dt * 1e3}
 
 
+def bench_eager_dispatch() -> None:
+    """Eager per-op dispatch cost (VERDICT round-1: the vjp-trace per op is
+    the eager engine's known hot spot; this tracks it) — diagnostic."""
+    try:
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+        x.stop_gradient = False
+        y = (x * 2 + 1).sum()                    # warm caches
+        float(y)
+        t0 = time.perf_counter()
+        n = 200
+        for _ in range(n):
+            z = x * 2                            # one tape-recorded op
+        float(z.sum())
+        per_op = (time.perf_counter() - t0) / n * 1e6
+        log(f"eager dispatch: {per_op:.0f} us/op (tape-recorded mul)")
+    except Exception as e:
+        log(f"eager dispatch bench failed: {e!r}")
+
+
 def bench_lenet_eager() -> None:
     """Config 1: LeNet eager (dygraph) step rate — diagnostic only."""
     try:
@@ -206,6 +227,7 @@ def main() -> None:
     log(f"devices: {jax.devices()}")
     full = "--quick" not in sys.argv
     if full:
+        bench_eager_dispatch()
         bench_lenet_eager()
         bench_resnet50()
     r = bench_bert_mlm()

@@ -11,7 +11,15 @@ import jax.numpy as jnp
 
 from ..core.tensor import apply
 
-__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle", "moe"]
+
+
+def __getattr__(name):
+    if name == "moe":
+        import importlib
+        return importlib.import_module(".moe", __name__)
+    raise AttributeError(
+        f"module 'paddle_tpu.incubate' has no attribute {name!r}")
 
 
 def softmax_mask_fuse(x, mask, name=None):
