@@ -215,6 +215,54 @@ def bench_resnet50() -> None:
         log(f"resnet50 bench failed: {e!r}")
 
 
+def bench_gpt2_345m() -> None:
+    """Config 4: GPT-2 345M causal LM, single chip (recompute + AMP) —
+    diagnostic; the PP+TP variant needs multi-chip hardware."""
+    try:
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.to_static import TrainStep
+        from paddle_tpu.models.gpt import (GPTForPretraining,
+                                           GPTPretrainingCriterion,
+                                           gpt2_medium)
+        from paddle_tpu.optimizer import AdamW
+
+        B, S = 8, 1024
+        cfg = gpt2_medium(use_recompute=True)
+        paddle.seed(0)
+        model = GPTForPretraining(cfg)
+        model.train()
+        crit = GPTPretrainingCriterion()
+
+        def loss_fn(layer, ids, labels):
+            with paddle.amp.auto_cast(level="O1"):
+                return crit(layer(ids), labels)
+
+        step = TrainStep(model, loss_fn,
+                         AdamW(learning_rate=1e-4,
+                               parameters=model.parameters(),
+                               weight_decay=0.01))
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        labels = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        t0 = time.perf_counter()
+        l0 = float(step(ids, labels))
+        log(f"gpt2-345M: compile+step1 {time.perf_counter() - t0:.1f}s "
+            f"loss={l0:.2f}")
+        for _ in range(2):
+            step(ids, labels)
+        float(step(ids, labels))
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(ids, labels)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        log(f"gpt2-345M: {dt*1e3:.1f} ms/step  {B*S/dt:,.0f} tok/s "
+            f"(B={B}, S={S}, recompute+AMP)")
+    except Exception as e:
+        log(f"gpt2-345M bench failed: {e!r}")
+
+
 def main() -> None:
     import jax
     # rbg keys: dropout mask generation is ~10x cheaper than threefry on
@@ -230,6 +278,7 @@ def main() -> None:
         bench_eager_dispatch()
         bench_lenet_eager()
         bench_resnet50()
+        bench_gpt2_345m()
     r = bench_bert_mlm()
     print(json.dumps({
         "metric": "bert_base_mlm_tokens_per_sec_per_chip",

@@ -85,6 +85,7 @@ from . import distribution  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401
+from . import onnx  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

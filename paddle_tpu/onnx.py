@@ -1,0 +1,49 @@
+"""paddle.onnx surface.
+
+reference parity: python/paddle/onnx/export.py — a thin wrapper delegating
+to the external `paddle2onnx` converter over a jit-saved inference model.
+
+TPU-native reality: the portable interchange format for XLA-compiled
+models is StableHLO, not ONNX — `export` produces the jit.save artifact
+set (.mlir StableHLO text + .jaxexport serialized executable + params),
+which StableHLO consumers (IREE, XLA AOT, onnx-mlir's StableHLO importer)
+ingest directly. No .onnx protobuf is written (no converter is shipped);
+the function says so loudly via a warning and its return value names the
+actual artifacts, so nothing downstream can mistake the output for ONNX.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` for interchange (reference: onnx/export.py).
+
+    Writes the StableHLO artifact set at ``path`` (same as jit.save) and
+    returns the ``path + ".mlir"`` it actually wrote. ``opset_version``
+    and ONNX-specific ``configs`` do not apply to StableHLO and are
+    rejected when set to non-defaults, rather than silently dropped.
+    """
+    from .jit.to_static import save as jit_save
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (static shapes)")
+    if opset_version != 9:
+        raise ValueError(
+            f"opset_version={opset_version} has no meaning for the "
+            "StableHLO export this framework produces; omit it")
+    if configs:
+        raise ValueError(
+            f"unsupported ONNX-specific options: {sorted(configs)} — the "
+            "export is StableHLO (.mlir/.jaxexport), not an .onnx protobuf")
+    jit_save(layer, path, input_spec=input_spec)
+    warnings.warn(
+        "paddle_tpu exports StableHLO, the XLA-native interchange format: "
+        f"wrote {path}.mlir (+ .jaxexport/.pdiparams). No .onnx protobuf "
+        "is produced; use a StableHLO->ONNX converter if you need one.",
+        stacklevel=2)
+    return path + ".mlir"

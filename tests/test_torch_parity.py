@@ -1,0 +1,137 @@
+"""Cross-framework training parity vs torch (CPU build baked into the
+image) — the BASELINE criterion is "loss-curve parity vs the GPU
+reference"; torch serves as the independent numerical oracle.
+
+Weights are COPIED (not re-initialized) into structurally identical torch
+models; then both sides train with plain SGD on identical data and the
+loss curves must track within f32 tolerance.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+
+
+def test_mlp_classifier_loss_curve_matches_torch():
+    rng = np.random.RandomState(0)
+    D, H, C, B = 16, 32, 4, 8
+    x_np = rng.randn(B, D).astype(np.float32)
+    y_np = rng.randint(0, C, (B,)).astype(np.int64)
+
+    paddle.seed(0)
+    ours = nn.Sequential(nn.Linear(D, H), nn.Tanh(), nn.Linear(H, C))
+    theirs = torch.nn.Sequential(torch.nn.Linear(D, H), torch.nn.Tanh(),
+                                 torch.nn.Linear(H, C))
+    # copy weights ours -> torch (our Linear weight is [in, out])
+    with torch.no_grad():
+        theirs[0].weight.copy_(torch.tensor(
+            np.asarray(ours[0].weight._data).T))
+        theirs[0].bias.copy_(torch.tensor(np.asarray(ours[0].bias._data)))
+        theirs[2].weight.copy_(torch.tensor(
+            np.asarray(ours[2].weight._data).T))
+        theirs[2].bias.copy_(torch.tensor(np.asarray(ours[2].bias._data)))
+
+    opt_o = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=ours.parameters())
+    opt_t = torch.optim.SGD(theirs.parameters(), lr=0.1)
+
+    ours_losses, torch_losses = [], []
+    xt = torch.tensor(x_np)
+    yt = torch.tensor(y_np)
+    for _ in range(20):
+        loss = F.cross_entropy(ours(paddle.to_tensor(x_np)),
+                               paddle.to_tensor(y_np))
+        loss.backward()
+        opt_o.step()
+        opt_o.clear_grad()
+        ours_losses.append(float(loss))
+
+        tl = torch.nn.functional.cross_entropy(theirs(xt), yt)
+        opt_t.zero_grad()
+        tl.backward()
+        opt_t.step()
+        torch_losses.append(float(tl))
+
+    np.testing.assert_allclose(ours_losses, torch_losses, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_transformer_encoder_layer_forward_matches_torch():
+    # one encoder layer, weights copied, same input -> same output
+    rng = np.random.RandomState(1)
+    D, Hh, FF, B, S = 16, 4, 32, 2, 10
+    x_np = rng.randn(B, S, D).astype(np.float32)
+
+    paddle.seed(1)
+    ours = nn.TransformerEncoderLayer(D, Hh, FF, dropout=0.0,
+                                      activation="relu", attn_dropout=0.0,
+                                      act_dropout=0.0,
+                                      normalize_before=False)
+    ours.eval()
+    theirs = torch.nn.TransformerEncoderLayer(
+        D, Hh, dim_feedforward=FF, dropout=0.0, activation="relu",
+        batch_first=True, norm_first=False)
+    theirs.eval()
+
+    def t(a):
+        return torch.tensor(np.asarray(a))
+
+    with torch.no_grad():
+        sa = ours.self_attn
+        wq = np.asarray(sa.q_proj.weight._data)   # [D, D] in->out
+        wk = np.asarray(sa.k_proj.weight._data)
+        wv = np.asarray(sa.v_proj.weight._data)
+        theirs.self_attn.in_proj_weight.copy_(
+            t(np.concatenate([wq.T, wk.T, wv.T], axis=0)))
+        theirs.self_attn.in_proj_bias.copy_(t(np.concatenate([
+            np.asarray(sa.q_proj.bias._data),
+            np.asarray(sa.k_proj.bias._data),
+            np.asarray(sa.v_proj.bias._data)])))
+        theirs.self_attn.out_proj.weight.copy_(
+            t(np.asarray(sa.out_proj.weight._data).T))
+        theirs.self_attn.out_proj.bias.copy_(
+            t(np.asarray(sa.out_proj.bias._data)))
+        theirs.linear1.weight.copy_(t(np.asarray(ours.linear1.weight._data).T))
+        theirs.linear1.bias.copy_(t(np.asarray(ours.linear1.bias._data)))
+        theirs.linear2.weight.copy_(t(np.asarray(ours.linear2.weight._data).T))
+        theirs.linear2.bias.copy_(t(np.asarray(ours.linear2.bias._data)))
+        theirs.norm1.weight.copy_(t(np.asarray(ours.norm1.weight._data)))
+        theirs.norm1.bias.copy_(t(np.asarray(ours.norm1.bias._data)))
+        theirs.norm2.weight.copy_(t(np.asarray(ours.norm2.weight._data)))
+        theirs.norm2.bias.copy_(t(np.asarray(ours.norm2.bias._data)))
+
+    with paddle.no_grad():
+        got = ours(paddle.to_tensor(x_np)).numpy()
+    with torch.no_grad():
+        ref = theirs(torch.tensor(x_np)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_layernorm_gelu_softmax_semantics_match_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 12).astype(np.float32)
+    np.testing.assert_allclose(
+        F.gelu(paddle.to_tensor(x)).numpy(),
+        torch.nn.functional.gelu(torch.tensor(x)).numpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        F.gelu(paddle.to_tensor(x), approximate=True).numpy(),
+        torch.nn.functional.gelu(torch.tensor(x), approximate="tanh")
+        .numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        F.softmax(paddle.to_tensor(x), axis=-1).numpy(),
+        torch.softmax(torch.tensor(x), dim=-1).numpy(),
+        rtol=1e-5, atol=1e-6)
+    ln = nn.LayerNorm(12)
+    tln = torch.nn.LayerNorm(12)
+    with torch.no_grad():
+        tln.weight.copy_(torch.tensor(np.asarray(ln.weight._data)))
+        tln.bias.copy_(torch.tensor(np.asarray(ln.bias._data)))
+    np.testing.assert_allclose(
+        ln(paddle.to_tensor(x)).numpy(),
+        tln(torch.tensor(x)).detach().numpy(), rtol=1e-5, atol=1e-5)
