@@ -1,9 +1,10 @@
 """Vision/detection operators.
 
 reference parity: python/paddle/vision/ops.py — yolo_box(:252),
-roi_align(:1145), roi_pool(:1022), psroi_pool(:911), nms (2.x surface;
-CUDA kernels under operators/detection/). deform_conv2d and the file IO
-ops (read_file/decode_jpeg need libjpeg op kernels) are not ported.
+deform_conv2d(:423), read_file(:819), decode_jpeg(:864),
+psroi_pool(:911), roi_pool(:1022), roi_align(:1145), nms (2.x surface;
+CUDA kernels under operators/detection/). decode_jpeg decodes host-side
+via PIL (the nvjpeg analogue on TPU systems is host IO).
 
 TPU-native notes: NMS is sequential by nature — implemented as a
 fixed-iteration `lax.while_loop`-free greedy scan with static shapes
@@ -20,13 +21,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import matmul_precision
 from ..core.tensor import Tensor, apply
 
-__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "yolo_box"]
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "yolo_box",
+           "psroi_pool", "deform_conv2d", "read_file", "decode_jpeg"]
 
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _roi_batch_index(boxes_num, n_rois):
+    """boxes_num [N] -> per-roi batch index [n_rois] (shared by the RoI
+    pool family)."""
+    bn = jnp.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                     else boxes_num)
+    return jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                      total_repeat_length=n_rois)
+
+
+def _bin_sample_grid(start, bin_size, n_bins, sr, center=True):
+    """Per-roi sampling coordinates [R, n_bins, sr] along one axis:
+    start + (bin + (s [+0.5])/sr) * bin_size."""
+    offs = (jnp.arange(sr) + 0.5) / sr if center else jnp.arange(sr) / sr
+    grid = jnp.arange(n_bins)[None, :, None] + offs[None, None, :]
+    return start[:, None, None] + grid * bin_size[:, None, None]
 
 
 def _iou_arrays(a, b):
@@ -115,19 +135,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        # sample grid [R, ph, sr] x [R, pw, sr]
-        iy = (jnp.arange(ph)[None, :, None]
-              + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
-        ys = y1[:, None, None] + iy * bin_h[:, None, None]     # [R, ph, sr]
-        ix = (jnp.arange(pw)[None, :, None]
-              + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
-        xs = x1[:, None, None] + ix * bin_w[:, None, None]     # [R, pw, sr]
-
-        # roi -> batch index from boxes_num
-        bn = jnp.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
-                         else boxes_num)
-        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
-                               total_repeat_length=rois.shape[0])
+        ys = _bin_sample_grid(y1, bin_h, ph, sr)               # [R, ph, sr]
+        xs = _bin_sample_grid(x1, bin_w, pw, sr)               # [R, pw, sr]
+        batch_idx = _roi_batch_index(boxes_num, rois.shape[0])
 
         def bilinear(img, yy, xx):
             # img [C, H, W]; yy [ph, sr]; xx [pw, sr]
@@ -176,18 +186,9 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         rw = jnp.maximum(x2 - x1, 1.0)
         rh = jnp.maximum(y2 - y1, 1.0)
         sr = 4                                   # dense enough per bin
-        ys = (y1[:, None, None]
-              + (jnp.arange(ph)[None, :, None]
-                 + (jnp.arange(sr)[None, None, :]) / sr)
-              * (rh / ph)[:, None, None])
-        xs = (x1[:, None, None]
-              + (jnp.arange(pw)[None, :, None]
-                 + (jnp.arange(sr)[None, None, :]) / sr)
-              * (rw / pw)[:, None, None])
-        bn = jnp.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
-                         else boxes_num)
-        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
-                               total_repeat_length=rois.shape[0])
+        ys = _bin_sample_grid(y1, rh / ph, ph, sr, center=False)
+        xs = _bin_sample_grid(x1, rw / pw, pw, sr, center=False)
+        batch_idx = _roi_batch_index(boxes_num, rois.shape[0])
 
         def pool(img, yy, xx):
             yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
@@ -251,3 +252,180 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
         return boxes * mask, scores * mask
 
     return apply(_yb, _t(x), _t(img_size), name="yolo_box")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py:911,
+    psroi_pool_op): input channels C = out_c * ph * pw; bin (i, j) of
+    output channel k averages input channel k*ph*pw + i*pw + j over the
+    bin's area."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def _ps(feat, rois):
+        N, C, H, W = feat.shape
+        if C % (ph * pw):
+            raise ValueError(
+                f"psroi_pool needs output_size {ph}x{pw} to divide the "
+                f"channel count, got C={C}")
+        out_c = C // (ph * pw)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        sr = 4
+        ys = _bin_sample_grid(y1, rh / ph, ph, sr)
+        xs = _bin_sample_grid(x1, rw / pw, pw, sr)
+        batch_idx = _roi_batch_index(boxes_num, rois.shape[0])
+        # per-bin channel map [out_c, ph, pw]
+        chan = (jnp.arange(out_c)[:, None, None] * (ph * pw)
+                + jnp.arange(ph)[None, :, None] * pw
+                + jnp.arange(pw)[None, None, :])
+
+        def pool(img, yy, xx):
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)   # [ph, sr]
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)   # [pw, sr]
+            # [C, ph, sr, pw, sr] -> bin means [C, ph, pw]
+            vals = img[:, yi[:, :, None, None], xi[None, None, :, :]] \
+                .mean(axis=(2, 4))
+            return vals[chan, jnp.arange(ph)[None, :, None],
+                        jnp.arange(pw)[None, None, :]]      # [out_c, ph, pw]
+
+        return jax.vmap(lambda bi, yy, xx: pool(feat[bi], yy, xx))(
+            batch_idx, ys, xs)
+
+    return apply(_ps, _t(x), _t(boxes), name="psroi_pool")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None, name=None):
+    """Deformable convolution v1/v2 (reference: vision/ops.py:423,
+    deformable_conv_op.cu): per-output-position learned offsets displace
+    each kernel tap; v2 additionally modulates taps with ``mask``.
+
+    TPU formulation: bilinear-gather all K taps into an im2col tensor
+    [N, C*K, oH, oW] (one vectorized gather — no per-pixel loops), then
+    one grouped 1x1 matmul. Supports deformable_groups/groups.
+    """
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = _pair(stride)
+    padh, padw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def _dc(xa, off, w, *rest):
+        maybe_mask = rest[0] if (mask is not None) else None
+        b = None
+        if bias is not None:
+            b = rest[-1]
+        N, C, H, W = xa.shape
+        out_c, c_per_g, kh, kw = w.shape
+        K = kh * kw
+        oH = (H + 2 * padh - (dh * (kh - 1) + 1)) // sh + 1
+        oW = (W + 2 * padw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        c_per_dg = C // dg
+
+        # base sampling grid per tap: [K, oH, oW]
+        base_y = (jnp.arange(oH)[None, :, None] * sh - padh
+                  + (jnp.arange(kh)[:, None, None] * dh)
+                  .repeat(kw, axis=0))
+        base_x = (jnp.arange(oW)[None, None, :] * sw - padw
+                  + jnp.tile(jnp.arange(kw), kh)[:, None, None] * dw)
+        base_y = jnp.broadcast_to(base_y, (K, oH, oW)).astype(jnp.float32)
+        base_x = jnp.broadcast_to(base_x, (K, oH, oW)).astype(jnp.float32)
+
+        # offsets: [N, dg*2*K, oH, oW] -> y/x per (dg, K)
+        off = off.reshape(N, dg, 2 * K, oH, oW)
+        off_y = off[:, :, 0::2]                     # [N, dg, K, oH, oW]
+        off_x = off[:, :, 1::2]
+        ys = base_y[None, None] + off_y
+        xs = base_x[None, None] + off_x
+
+        def gather_one(img_dg, yy, xx):
+            # img_dg [c_per_dg, H, W]; yy/xx [K, oH, oW]
+            y = jnp.clip(yy, -1.0, H + 0.0)
+            xc = jnp.clip(xx, -1.0, W + 0.0)
+            y0 = jnp.floor(y)
+            x0 = jnp.floor(xc)
+            wy = y - y0
+            wx = xc - x0
+
+            def at(yi, xi):
+                inb = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                yi_ = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xi_ = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                v = img_dg[:, yi_, xi_]             # [c, K, oH, oW]
+                return v * inb[None]
+
+            val = (at(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                   + at(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                   + at(y0 + 1, x0) * (wy * (1 - wx))[None]
+                   + at(y0 + 1, x0 + 1) * (wy * wx)[None])
+            return val                               # [c, K, oH, oW]
+
+        # vmap over batch and deformable groups
+        imgs = xa.reshape(N, dg, c_per_dg, H, W)
+        cols = jax.vmap(jax.vmap(gather_one))(imgs, ys, xs)
+        # [N, dg, c_per_dg, K, oH, oW] -> [N, C, K, oH, oW]
+        cols = cols.reshape(N, C, K, oH, oW)
+        if maybe_mask is not None:                   # v2 modulation
+            m = maybe_mask.reshape(N, dg, K, oH, oW)
+            m = jnp.repeat(m, c_per_dg, axis=1).reshape(N, C, K, oH, oW)
+            cols = cols * m
+
+        # grouped contraction with the kernel: w [out_c, c_per_g, kh*kw]
+        wg = w.reshape(groups, out_c // groups, c_per_g, K)
+        colg = cols.reshape(N, groups, c_per_g, K, oH, oW)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                         precision=matmul_precision())
+        out = out.reshape(N, out_c, oH, oW)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [_t(x), _t(offset), _t(weight)]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(_dc, *args, name="deform_conv2d")
+
+
+def read_file(filename, name=None):
+    """Read file bytes as a uint8 tensor (reference: vision/ops.py:819)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """Decode JPEG bytes to [C, H, W] uint8 (reference: vision/ops.py:864,
+    CUDA nvjpeg op). Host-side decode via PIL — image IO is host work on
+    TPU systems; the device gets the decoded array."""
+    import io
+
+    from PIL import Image
+
+    if mode not in ("unchanged", "gray", "rgb"):
+        raise ValueError(f"decode_jpeg mode must be 'unchanged', 'gray' "
+                         f"or 'rgb', got {mode!r}")
+    raw = np.asarray(x._data if isinstance(x, Tensor) else x,
+                     dtype=np.uint8).tobytes()
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

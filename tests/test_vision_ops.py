@@ -93,3 +93,87 @@ def test_yolo_box_shapes_and_range():
     assert (b >= 0).all() and (b <= 320).all()   # clipped to image
     s = scores.numpy()
     assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    # with zero offsets (and no mask) deformable conv == standard conv
+    rng = np.random.RandomState(10)
+    N, C, H, W, OC, K = 2, 4, 8, 8, 6, 3
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    w = rng.randn(OC, C, K, K).astype(np.float32)
+    b = rng.randn(OC).astype(np.float32)
+    oH = oW = H  # padding 1, stride 1
+    offset = np.zeros((N, 2 * K * K, oH, oW), np.float32)
+
+    got = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                            paddle.to_tensor(w), bias=paddle.to_tensor(b),
+                            stride=1, padding=1).numpy()
+    import paddle_tpu.nn.functional as F
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), stride=1, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv2d_mask_modulates():
+    rng = np.random.RandomState(11)
+    N, C, H, W, OC, K = 1, 2, 6, 6, 3, 3
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    w = rng.randn(OC, C, K, K).astype(np.float32)
+    offset = np.zeros((N, 2 * K * K, H, W), np.float32)
+    mask0 = np.zeros((N, K * K, H, W), np.float32)     # all taps off
+    out = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                            paddle.to_tensor(w), stride=1, padding=1,
+                            mask=paddle.to_tensor(mask0)).numpy()
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_deform_conv2d_gradients_flow():
+    rng = np.random.RandomState(12)
+    x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    off = paddle.to_tensor(
+        (rng.randn(1, 18, 6, 6) * 0.1).astype(np.float32))
+    off.stop_gradient = False
+    w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype(np.float32))
+    w.stop_gradient = False
+    out = ops.deform_conv2d(x, off, w, stride=1, padding=1)
+    out.sum().backward()
+    for t, name in ((x, "x"), (off, "offset"), (w, "weight")):
+        g = np.asarray(t.grad._data)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0, name
+
+
+def test_psroi_pool_constant_feature():
+    ph = pw = 2
+    out_c = 3
+    C = out_c * ph * pw
+    feat = np.full((1, C, 8, 8), 0.0, np.float32)
+    for c in range(C):
+        feat[0, c] = c                  # channel-identifying values
+    rois = np.array([[0, 0, 8, 8]], np.float32)
+    out = ops.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                         np.array([1]), output_size=ph).numpy()
+    assert out.shape == (1, out_c, ph, pw)
+    # bin (i, j) of output channel k reads input channel k*ph*pw + i*pw + j
+    for k in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, k, i, j] == k * ph * pw + i * pw + j
+
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    # smooth gradient image (random noise is JPEG's worst case)
+    yy, xx = np.mgrid[0:16, 0:20]
+    img = np.stack([yy * 8, xx * 6, (yy + xx) * 4], axis=-1) \
+        .astype(np.uint8)
+    p = tmp_path / "img.jpg"
+    Image.fromarray(img).save(p, quality=95)
+    data = ops.read_file(str(p))
+    assert data.dtype.name == "uint8"
+    decoded = ops.decode_jpeg(data, mode="rgb")
+    assert tuple(decoded.shape) == (3, 16, 20)
+    # lossy codec: just check it is recognisably the same image
+    err = np.abs(decoded.numpy().transpose(1, 2, 0).astype(np.int32)
+                 - img.astype(np.int32)).mean()
+    assert err < 20
