@@ -9,7 +9,7 @@ sharding/sp) over XLA collectives.
 Public namespace mirrors `paddle.*`.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 import jax as _jax
 

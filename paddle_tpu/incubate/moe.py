@@ -34,6 +34,37 @@ __all__ = ["global_scatter", "global_gather", "top2_gating", "ExpertFFN",
 EP_AXIS = "ep"
 
 
+def _check_uniform_counts(counts, what: str, total: Optional[int] = None):
+    """The static-shape all_to_all only implements the uniform-counts case
+    (GShard fixed capacity). Variable per-expert counts — the reference's
+    general global_scatter semantics — would silently mis-route rows here,
+    so reject them loudly instead."""
+    if counts is None:
+        return
+    import numpy as np
+    if isinstance(counts, Tensor):
+        counts = counts._data
+    if isinstance(counts, jax.core.Tracer):
+        # Inside shard_map/jit the counts arrive as tracers whose values
+        # cannot be inspected; uniformity is then the caller's contract
+        # (the tiled all_to_all silently assumes it). Concrete counts —
+        # the eager reference-parity call — are validated below.
+        return
+    arr = np.asarray(counts)
+    if arr.size and not (arr == arr.flat[0]).all():
+        raise NotImplementedError(
+            f"global_scatter/global_gather: non-uniform {what} "
+            f"{arr.tolist()} is unsupported — the TPU lowering is a tiled "
+            "all_to_all which requires equal rows per expert (GShard "
+            "capacity discipline); pad every expert to the same count")
+    if total is not None and arr.size and int(arr.sum()) != int(total):
+        raise ValueError(
+            f"global_scatter/global_gather: {what} sums to {int(arr.sum())} "
+            f"but x has {int(total)} rows — the tiled all_to_all moves "
+            "rows/ep_size rows per rank, so the counts must describe "
+            "exactly the rows present")
+
+
 def global_scatter(x, local_count, global_count, group=None):
     """Send rows of ``x`` to experts on other ranks (call inside shard_map
     over the ep axis; reference: distributed/utils.py:57).
@@ -41,11 +72,13 @@ def global_scatter(x, local_count, global_count, group=None):
     local_count[i]: rows this rank sends to global expert i;
     global_count[i]: rows this rank receives for its local experts.
     Counts must be equal-per-rank (fixed capacity) for the static-shape
-    all-to-all — the GShard capacity discipline.
+    all-to-all — the GShard capacity discipline; non-uniform counts raise.
     """
     from jax import lax
-    n = lax.psum(1, EP_AXIS)
     rows = x.shape[0]
+    _check_uniform_counts(local_count, "local_count", total=rows)
+    _check_uniform_counts(global_count, "global_count", total=rows)
+    n = lax.psum(1, EP_AXIS)
     if rows % n:
         raise ValueError(f"rows {rows} must divide ep size {n}")
     return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
@@ -55,6 +88,9 @@ def global_scatter(x, local_count, global_count, group=None):
 def global_gather(x, local_count, global_count, group=None):
     """Inverse of global_scatter (reference: distributed/utils.py:151)."""
     from jax import lax
+    rows = x.shape[0]
+    _check_uniform_counts(local_count, "local_count", total=rows)
+    _check_uniform_counts(global_count, "global_count", total=rows)
     return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
                           tiled=True)
 

@@ -8,13 +8,17 @@ pybind11 in the image — and the payloads are arbitrary byte buffers
 the Python producer immediately.
 
 The shared library is compiled on first use with g++ and cached next to
-the source; `native_available()` reports whether the toolchain produced a
-usable library (callers fall back to queue.Queue).
+the source under a name that embeds the source hash — a changed
+blocking_queue.cpp can never be served by a stale binary (and no binary
+is ever checked into version control). `native_available()` reports
+whether the toolchain produced a usable library (callers fall back to
+queue.Queue).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,9 +26,20 @@ from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(__file__), "_native",
                     "blocking_queue.cpp")
-_LIB = os.path.join(os.path.dirname(__file__), "_native",
-                    "libblocking_queue.so")
+
+
+def _lib_path() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        return None          # source not shipped: fall back gracefully
+    return os.path.join(os.path.dirname(__file__), "_native",
+                        f"libblocking_queue-{digest}.so")
+
+
 _lib_handle = None
+_build_failed = False      # failures are cached: one compile attempt/process
 _build_lock = threading.Lock()
 
 
@@ -37,25 +52,50 @@ class QueueKilled(Exception):
 
 
 def _build() -> Optional[ctypes.CDLL]:
-    global _lib_handle
+    global _lib_handle, _build_failed
     with _build_lock:
         if _lib_handle is not None:
             return _lib_handle
-        if not os.path.exists(_LIB) or (
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if _build_failed:
+            return None
+        lib_file = _lib_path()
+        if lib_file is None:
+            _build_failed = True
+            return None
+        if not os.path.exists(lib_file):
+            # build to a private temp path and atomically publish, so a
+            # concurrent/interrupted build can never leave a half-written
+            # .so at the trusted final name
+            tmp = f"{lib_file}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC,
-                     "-o", _LIB],
+                     "-o", tmp],
                     check=True, capture_output=True, timeout=120)
+                os.replace(tmp, lib_file)
             except (subprocess.SubprocessError, FileNotFoundError, OSError):
-                # no toolchain: still try any existing library (git does
-                # not preserve mtimes, so a shipped .so may look stale)
-                if not os.path.exists(_LIB):
-                    return None
+                _build_failed = True
+                return None
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+            # sweep caches of older source revisions (incl. the legacy
+            # un-hashed name)
+            import glob
+            for old in glob.glob(os.path.join(
+                    os.path.dirname(lib_file), "libblocking_queue*.so")):
+                if old != lib_file:
+                    try:
+                        os.remove(old)
+                    except OSError:
+                        pass
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_file)
         except OSError:
+            _build_failed = True
             return None
         lib.pq_create.restype = ctypes.c_void_p
         lib.pq_create.argtypes = [ctypes.c_size_t]

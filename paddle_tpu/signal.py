@@ -124,6 +124,10 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     x = _as_tensor(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False (a "
+            "onesided spectrum can only reconstruct a real signal)")
     if window is not None:
         w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
     else:
@@ -136,8 +140,12 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
         spec = jnp.swapaxes(a, -1, -2)                  # [..., nf, bins]
         if normalized:
             spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
-                  else jnp.fft.ifft(spec, axis=-1).real)  # [..., nf, n_fft]
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)        # complex
+            if not return_complex:
+                frames = frames.real
         frames = frames * wa
         nf = frames.shape[-2]
         seq = (nf - 1) * hop_length + n_fft

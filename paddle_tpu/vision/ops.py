@@ -170,34 +170,101 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
              name=None):
-    """RoIPool via max over a dense RoIAlign grid (reference:
-    vision/ops.py:1022). Uses a fine sampling grid + max reduction — the
-    static-shape TPU formulation of the adaptive-bin max."""
+    """RoIPool: exact max over every integer position in each adaptive bin
+    (reference: vision/ops.py:1022, operators/roi_pool_op). Bin boundaries
+    use the reference math exactly — rounded UNCLIPPED RoI coords give
+    rw/rh, each bin is then clipped to the image, fully-clipped bins
+    return 0.
+
+    Static-shape TPU formulation: a bin may span anywhere from 0 to the
+    whole image, so instead of bounding positions-per-bin each axis is
+    reduced with a sparse-table range max: sliding power-of-2 window maxima
+    are built level by level (log2(size) levels), and every bin's
+    [start, end) max is two gathers from the level matching its width. The
+    levels are swept progressively — one live window buffer, never a
+    stacked [L, ...] table and never a per-RoI copy — so peak memory is
+    one [R, pw, C, H] intermediate. Exact for every bin size.
+    """
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
 
+    def _round_c(v):
+        # C round(): half away from zero (jnp.round is half-to-even)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
     def _rp(feat, rois):
         N, C, H, W = feat.shape
-        x1 = jnp.floor(rois[:, 0] * spatial_scale)
-        y1 = jnp.floor(rois[:, 1] * spatial_scale)
-        x2 = jnp.ceil(rois[:, 2] * spatial_scale)
-        y2 = jnp.ceil(rois[:, 3] * spatial_scale)
-        rw = jnp.maximum(x2 - x1, 1.0)
-        rh = jnp.maximum(y2 - y1, 1.0)
-        sr = 4                                   # dense enough per bin
-        ys = _bin_sample_grid(y1, rh / ph, ph, sr, center=False)
-        xs = _bin_sample_grid(x1, rw / pw, pw, sr, center=False)
-        batch_idx = _roi_batch_index(boxes_num, rois.shape[0])
+        x1 = _round_c(rois[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = _round_c(rois[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = _round_c(rois[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = _round_c(rois[:, 3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
 
-        def pool(img, yy, xx):
-            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
-            vals = img[:, yi[:, :, None, None], xi[None, None, :, :]]
-            return vals.max(axis=(2, 4))
+        def bin_edges(start, rsz, nb, size):
+            # [R, nb] int bin [start, end) per reference: floor/ceil of the
+            # adaptive boundary offset by the RoI start, clipped to image.
+            # Pure integer arithmetic — float division would overshoot
+            # exact boundaries (e.g. 21/7 -> 3.0000002 under XLA).
+            i = jnp.arange(nb, dtype=jnp.int32)
+            bs = (i[None, :] * rsz[:, None]) // nb + start[:, None]
+            be = -((-(i[None, :] + 1) * rsz[:, None]) // nb) + start[:, None]
+            return (jnp.clip(bs, 0, size).astype(jnp.int32),
+                    jnp.clip(be, 0, size).astype(jnp.int32))
 
-        return jax.vmap(lambda bi, yy, xx: pool(feat[bi], yy, xx))(
-            batch_idx, ys, xs)
+        hs, he = bin_edges(y1, rh, ph, H)         # [R, ph]
+        ws, we = bin_edges(x1, rw, pw, W)         # [R, pw]
+        R = rois.shape[0]
+        batch_idx = _roi_batch_index(boxes_num, R)
+        import math as _m
+
+        def _qlevel(s, e, size):
+            # sparse-table level per [s, e) query: lvl = floor(log2(e-s)),
+            # computed with integer comparisons (no float-log edge cases)
+            ln = jnp.maximum(e - s, 1)
+            lvl = jnp.zeros(ln.shape, jnp.int32)
+            k = 1
+            while (1 << k) <= size:
+                lvl = lvl + (ln >= (1 << k)).astype(jnp.int32)
+                k += 1
+            return lvl, jnp.left_shift(jnp.int32(1), lvl)
+
+        def _shift_max(cur, p):
+            # cur[..., s] = max over a window of p: widen to 2p
+            pad = jnp.full(cur.shape[:-1] + (p,), -jnp.inf, cur.dtype)
+            return jnp.maximum(
+                cur, jnp.concatenate([cur[..., p:], pad], axis=-1))
+
+        # stage 1 — column range max: colmax[r, j, c, h] =
+        # max(feat[bi_r, c, h, ws_rj:we_rj])
+        lvl_x, pow_x = _qlevel(ws, we, W)            # [R, pw]
+        sx = jnp.clip(ws, 0, W - 1)
+        ex = jnp.clip(we - pow_x, 0, W - 1)
+        colmax = jnp.full((R, pw, feat.shape[1], H), -jnp.inf, feat.dtype)
+        cur = feat                                # [N, C, H, W]
+        for lv in range(max(1, int(_m.floor(_m.log2(W))) + 1)):
+            v = jnp.maximum(cur[batch_idx[:, None], :, :, sx],
+                            cur[batch_idx[:, None], :, :, ex])
+            colmax = jnp.where((lvl_x == lv)[:, :, None, None], v, colmax)
+            cur = _shift_max(cur, 1 << lv)
+
+        # stage 2 — row range max over colmax's h axis
+        lvl_y, pow_y = _qlevel(hs, he, H)            # [R, ph]
+        sy = jnp.clip(hs, 0, H - 1)
+        ey = jnp.clip(he - pow_y, 0, H - 1)
+        ridx = jnp.arange(R)[:, None]
+        out = jnp.full((R, ph, pw, feat.shape[1]), -jnp.inf, feat.dtype)
+        cur = colmax                              # [R, pw, C, H]
+        for lv in range(max(1, int(_m.floor(_m.log2(H))) + 1)):
+            v = jnp.maximum(cur[ridx, :, :, sy],  # [R, ph, pw, C]
+                            cur[ridx, :, :, ey])
+            out = jnp.where((lvl_y == lv)[:, :, None, None], v, out)
+            cur = _shift_max(cur, 1 << lv)
+
+        out = jnp.transpose(out, (0, 3, 1, 2))    # [R, C, ph, pw]
+        empty = (he <= hs)[:, :, None] | (we <= ws)[:, None, :]
+        return jnp.where(empty[:, None], 0.0, out)
 
     return apply(_rp, _t(x), _t(boxes), name="roi_pool")
 
