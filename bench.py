@@ -215,6 +215,73 @@ def bench_resnet50() -> None:
         log(f"resnet50 bench failed: {e!r}")
 
 
+def bench_gpt2_pp_tp() -> None:
+    """Config 4 proper: GPT-2 345M over a pp×mp mesh — the SPMD pipeline
+    (scan+ppermute stages) composed with tensor parallelism. Runs whenever
+    ≥4 devices are visible; on the single-chip bench harness it logs a
+    skip (the schedule itself is validated by tests/test_spmd_pipeline.py
+    and the driver's dryrun_multichip on a virtual mesh)."""
+    try:
+        import jax
+        n = len(jax.devices())
+        if n < 4:
+            log(f"gpt2-345M PP+TP: skipped ({n} device(s) visible; needs a "
+                "pp×mp mesh of ≥4 chips — dryrun_multichip config A "
+                "exercises this path on a virtual mesh)")
+            return
+        import paddle_tpu as paddle
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.jit.to_static import TrainStep
+        from paddle_tpu.models.gpt import (GPTForPretrainingPipe,
+                                           GPTPretrainingCriterion,
+                                           gpt2_medium)
+        from paddle_tpu.optimizer import AdamW
+
+        pp, mp = 2, 2
+        dp = n // (pp * mp)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "pp_degree": pp,
+                                   "mp_degree": mp}
+        fleet.init(is_collective=True, strategy=strategy)
+        mesh = fleet.get_hybrid_communicate_group().mesh
+
+        B, S, M = 8 * dp, 1024, 8
+        cfg = gpt2_medium()
+        paddle.seed(0)
+        model = GPTForPretrainingPipe(cfg, num_microbatches=M)
+        model = fleet.distributed_model(model)
+        crit = GPTPretrainingCriterion()
+
+        def loss_fn(layer, ids, labels):
+            with paddle.amp.auto_cast(level="O1"):
+                return crit(layer(ids), labels)
+
+        step = TrainStep(model, loss_fn,
+                         AdamW(learning_rate=1e-4, weight_decay=0.01),
+                         mesh=mesh, data_spec=P("dp"), zero_axis="dp")
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        labels = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        t0 = time.perf_counter()
+        l0 = float(step(ids, labels))
+        log(f"gpt2-345M PP+TP: compile+step1 {time.perf_counter()-t0:.1f}s "
+            f"loss={l0:.2f} mesh(dp={dp},pp={pp},mp={mp})")
+        for _ in range(2):
+            step(ids, labels)
+        float(step(ids, labels))
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(ids, labels)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        log(f"gpt2-345M PP+TP: {dt*1e3:.1f} ms/step  {B*S/dt:,.0f} tok/s "
+            f"({B*S/dt/n:,.0f} tok/s/chip, B={B}, S={S}, M={M} microbatches)")
+    except Exception as e:
+        log(f"gpt2-345M PP+TP bench failed: {e!r}")
+
+
 def bench_gpt2_345m() -> None:
     """Config 4: GPT-2 345M causal LM, single chip (recompute + AMP) —
     diagnostic; the PP+TP variant needs multi-chip hardware."""
@@ -279,6 +346,7 @@ def main() -> None:
         bench_lenet_eager()
         bench_resnet50()
         bench_gpt2_345m()
+        bench_gpt2_pp_tp()
     r = bench_bert_mlm()
     print(json.dumps({
         "metric": "bert_base_mlm_tokens_per_sec_per_chip",

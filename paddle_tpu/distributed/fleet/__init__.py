@@ -106,6 +106,16 @@ def distributed_model(model):
     mode = hcg.get_parallel_mode()
     from .. import meta_parallel
     if mode == "pipeline":
+        from ..meta_parallel.spmd_pipeline import PipelineStageStack
+        from ..meta_parallel.parallel_layers.pp_layers import PipelineLayer
+        if not isinstance(model, PipelineLayer) and any(
+                isinstance(sub, PipelineStageStack)
+                for sub in model.sublayers(include_self=True)):
+            # the model already carries an SPMD pipeline (stacked params
+            # sharded over the pp mesh axis, scan+ppermute schedule): it IS
+            # the distributed model — just lay its params on the mesh
+            from ..spmd import apply_param_shardings
+            return apply_param_shardings(model, hcg.mesh)
         return meta_parallel.PipelineParallel(model, hcg, _strategy())
     if mode == "model":
         return meta_parallel.TensorParallel(model, hcg, _strategy())

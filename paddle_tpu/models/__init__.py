@@ -8,14 +8,16 @@ TP/DP/SP sharding stack.
 """
 
 from .gpt import (GPTConfig, GPTModel, GPTForPretraining,
-                  GPTPretrainingCriterion, gpt_tiny, gpt2_small, gpt2_medium)
+                  GPTForPretrainingPipe, GPTPretrainingCriterion, gpt_tiny,
+                  gpt2_small, gpt2_medium)
 from .bert import (BertConfig, BertModel, BertForMaskedLM, bert_tiny,
                    bert_base)
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ernie_tiny, ernie_base, ernie_3_1p5b)
 
 __all__ = [
-    "GPTConfig", "GPTModel", "GPTForPretraining", "GPTPretrainingCriterion",
+    "GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
+    "GPTPretrainingCriterion",
     "gpt_tiny", "gpt2_small", "gpt2_medium",
     "BertConfig", "BertModel", "BertForMaskedLM", "bert_tiny", "bert_base",
     "ErnieConfig", "ErnieModel", "ErnieForPretraining", "ernie_tiny",

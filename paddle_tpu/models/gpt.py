@@ -49,7 +49,7 @@ from ..nn.layer import Layer, LayerList
 from ..nn.layers.common import Dropout, Embedding
 from ..nn.layers.norm import LayerNorm
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForPretraining",
+__all__ = ["GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium"]
 
 MP = "mp"
@@ -348,6 +348,61 @@ def gpt2_medium(**kw) -> GPTConfig:
 # ---------------------------------------------------------------------------
 # Pipeline-parallel GPT (BASELINE config 4: GPT-2 345M PP + TP)
 # ---------------------------------------------------------------------------
+
+
+class GPTForPretrainingPipe(Layer):
+    """GPT with the decoder stack as an SPMD pipeline over the ``pp`` mesh
+    axis (BASELINE config 4: PP + TP).
+
+    reference: the model-zoo GPTForPretrainingPipe over
+    fleet/meta_parallel/pipeline_parallel.py. TPU-native: the N decoder
+    blocks live in a :class:`PipelineStageStack` — layer-stacked params
+    sharded over ``pp``, one scan+ppermute program (see spmd_pipeline.py);
+    embeddings/final-norm/tied head stay outside the pipeline, replicated
+    over ``pp`` and sharded over ``mp``/data axes by GSPMD exactly as in
+    GPTForPretraining. TP composes *inside* each stage because the
+    pipeline's shard_map is manual only over ``pp``.
+
+    Degrades to sequential execution (same params, same math) when no mesh
+    or pp degree 1 is active.
+    """
+
+    def __init__(self, cfg: GPTConfig,
+                 num_microbatches: Optional[int] = None):
+        super().__init__()
+        from ..distributed.meta_parallel.spmd_pipeline import (
+            PipelineStageStack)
+        self.cfg = cfg
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size)
+        self.word_embeddings.weight._data = Normal(
+            0.0, cfg.initializer_range)(
+            (cfg.vocab_size, cfg.hidden_size), "float32")
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.position_embeddings.weight._data = Normal(
+            0.0, cfg.initializer_range)(
+            (cfg.max_position_embeddings, cfg.hidden_size), "float32")
+        self.embedding_dropout = Dropout(cfg.hidden_dropout_prob)
+        self.blocks = PipelineStageStack(
+            lambda: GPTDecoderLayer(cfg), cfg.num_layers,
+            num_microbatches=num_microbatches)
+        self.final_norm = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            from ..tensor.creation import arange
+            position_ids = arange(0, S, dtype="int32")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        x = self.embedding_dropout(x)
+        sp = _seq_spec(self.cfg)
+        if sp:
+            x = _constrain(x, BATCH, sp, None)
+        x = self.blocks(x)
+        x = self.final_norm(x)
+        return parallel_logits(x, self.word_embeddings.weight)
 
 
 class _GPTEmbeddingStage(Layer):
