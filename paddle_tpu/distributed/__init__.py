@@ -9,6 +9,7 @@ meta-optimizer program rewrites→sharding specs + function transforms.
 from . import env  # noqa: F401
 from . import collective  # noqa: F401
 from . import spmd  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,

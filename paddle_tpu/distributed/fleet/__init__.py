@@ -25,7 +25,8 @@ __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
            "CommunicateTopology", "get_hybrid_communicate_group",
            "distributed_model", "distributed_optimizer",
            "worker_index", "worker_num", "is_first_worker",
-           "barrier_worker", "init_is_called"]
+           "barrier_worker", "init_is_called",
+           "save_persistables", "load_persistables"]
 
 _fleet_state = {"initialized": False, "strategy": None}
 
@@ -123,6 +124,67 @@ def distributed_model(model):
         return meta_parallel.ShardingParallel(model, hcg, _strategy())
     from ..parallel import DataParallel
     return DataParallel(model)
+
+
+def save_persistables(obj, dirname: str, asynchronous: bool = True):
+    """Sharded async save of training state (reference: fleet_base.py:779
+    save_persistables funnels every persistable through trainer 0; here
+    each host writes only its own shards — distributed.checkpoint).
+
+    ``obj`` is a TrainStep (full state incl. optimizer slots) or a Layer
+    (params + buffers only)."""
+    from .. import checkpoint as dckpt
+    from ...jit.to_static import TrainStep
+    if isinstance(obj, TrainStep):
+        dckpt.save_train_step(obj, dirname, asynchronous=asynchronous)
+        return
+    state = {"params": {k: p._data for k, p in obj.named_parameters()},
+             "buffers": {k: b._data for k, b in obj.named_buffers()}}
+    dckpt.save(state, dirname, asynchronous=asynchronous)
+
+
+def load_persistables(obj, dirname: str):
+    """Restore state saved by save_persistables, resharding to the current
+    mesh layout (reference: fleet_base.py load via executor)."""
+    import jax
+
+    from .. import checkpoint as dckpt
+    from .. import env as dist_env
+    from ...jit.to_static import TrainStep
+    if isinstance(obj, TrainStep):
+        dckpt.load_train_step(obj, dirname)
+        return obj
+    # Layer path: restore into the layer's current layout (mesh + specs)
+    # and load through set_state_dict for shape validation + key reporting
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = dist_env.get_mesh()
+
+    def sds(p, spec):
+        if mesh is None:
+            return jax.ShapeDtypeStruct(tuple(p.shape), p._data.dtype)
+        return jax.ShapeDtypeStruct(
+            tuple(p.shape), p._data.dtype,
+            sharding=NamedSharding(mesh, spec or P()))
+
+    target = {
+        "params": {k: sds(p, getattr(p, "spec", None))
+                   for k, p in obj.named_parameters()},
+        "buffers": {k: sds(b, None) for k, b in obj.named_buffers()},
+    }
+    state = dckpt.load(dirname, target=target)
+    params = dict(obj.named_parameters())
+    bufs = dict(obj.named_buffers())
+    obj.set_state_dict({**state.get("params", {}),
+                        **state.get("buffers", {})})
+    # set_state_dict re-asserts dtypes via jnp.asarray; re-pin shardings
+    if mesh is not None:
+        for k, v in state.get("params", {}).items():
+            if k in params:
+                params[k]._data = v
+        for k, v in state.get("buffers", {}).items():
+            if k in bufs:
+                bufs[k]._data = v
+    return obj
 
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):

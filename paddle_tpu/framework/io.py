@@ -2,8 +2,9 @@
 
 Reference: python/paddle/framework/io.py:553 (save), :769 (load) — pickle of
 nested state_dicts with Tensor→numpy conversion. Kept byte-compatible in
-spirit (pickle of numpy arrays); the sharded/async checkpoint path for
-distributed training lives in paddle_tpu.distributed.checkpoint (orbax).
+spirit (pickle of numpy arrays). The sharded/async/reshard-on-load
+checkpoint path for distributed training is paddle_tpu.distributed
+.checkpoint (orbax-backed; see TrainStep.save_sharded/load_sharded).
 """
 
 from __future__ import annotations

@@ -214,12 +214,31 @@ class TrainStep:
 
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
                  metrics_fn: Optional[Callable] = None, donate: bool = True,
-                 mesh=None, data_spec=None, zero_axis: Optional[str] = None):
+                 mesh=None, data_spec=None, zero_axis: Optional[str] = None,
+                 grad_accum_steps: Optional[int] = None,
+                 grad_accum_avg: Optional[bool] = None):
         from ..distributed import env as dist_env
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.metrics_fn = metrics_fn
+        if grad_accum_steps is None:
+            # adopt fleet's gradient_merge strategy when active (reference:
+            # fleet/meta_optimizers/gradient_merge_optimizer.py); a
+            # misconfigured strategy must FAIL here, not silently train
+            # with k=1
+            grad_accum_steps = 1
+            from ..distributed.fleet import _strategy, init_is_called
+            if init_is_called() and _strategy().gradient_merge:
+                cfg = _strategy().gradient_merge_configs
+                grad_accum_steps = int(cfg["k_steps"])
+                if grad_accum_avg is None:
+                    grad_accum_avg = bool(cfg.get("avg", True))
+        self.grad_accum_steps = max(1, int(grad_accum_steps))
+        self.grad_accum_avg = True if grad_accum_avg is None \
+            else grad_accum_avg
+        self._acc_grads = None
+        self._micro_count = 0
         self.mesh = mesh if mesh is not None else (
             dist_env.get_mesh() if data_spec is not None or zero_axis else None)
         self.data_spec = data_spec
@@ -290,11 +309,12 @@ class TrainStep:
 
         return [put(a) for a in raw]
 
-    def _make_step(self, treedef, training=True, check_finite=False):
-        layer, loss_fn, optimizer = self.layer, self.loss_fn, self.optimizer
-        frozen = self.frozen
+    def _loss_and_grads(self, treedef):
+        """Shared fwd+bwd kernel: (params, buffers, key, flat_batch) ->
+        ((loss, new_bufs), grads)."""
+        layer, loss_fn, frozen = self.layer, self.loss_fn, self.frozen
 
-        def step(params, buffers, opt_state, lr, t, key, flat_batch):
+        def run(params, buffers, key, flat_batch):
             batch = jax.tree_util.tree_unflatten(treedef, flat_batch)
 
             def compute_loss(p):
@@ -306,8 +326,16 @@ class TrainStep:
                 loss_arr = loss._data if isinstance(loss, Tensor) else loss
                 return loss_arr.astype(jnp.float32), bufs
 
-            (loss, new_bufs), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
+            return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+        return run
+
+    def _make_step(self, treedef, training=True, check_finite=False):
+        optimizer = self.optimizer
+        run = self._loss_and_grads(treedef)
+
+        def step(params, buffers, opt_state, lr, t, key, flat_batch):
+            (loss, new_bufs), grads = run(params, buffers, key, flat_batch)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr, t)
             if check_finite:
@@ -322,12 +350,98 @@ class TrainStep:
 
         return step
 
+    # -- gradient merge (k-step accumulation) ------------------------------
+    # reference: fleet/meta_optimizers/gradient_merge_optimizer.py — the
+    # program rewrite that accumulates grads into persistent buffers and
+    # gates the optimizer on step % k. TPU-native: two compiled programs
+    # (accumulate-only and accumulate+update) over a donated accumulator
+    # pytree; no cond divergence inside one program.
+    def _make_accum_step(self, treedef):
+        run = self._loss_and_grads(treedef)
+
+        def step(params, buffers, acc, key, flat_batch):
+            (loss, new_bufs), grads = run(params, buffers, key, flat_batch)
+            new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return new_bufs, new_acc, loss
+
+        return step
+
+    def _make_apply_step(self, treedef, check_finite=False):
+        optimizer = self.optimizer
+        k = self.grad_accum_steps
+        avg = self.grad_accum_avg
+        run = self._loss_and_grads(treedef)
+
+        def step(params, buffers, opt_state, acc, lr, t, key, flat_batch):
+            (loss, new_bufs), grads = run(params, buffers, key, flat_batch)
+            total = jax.tree_util.tree_map(jnp.add, acc, grads)
+            if avg:
+                total = jax.tree_util.tree_map(lambda g: g / k, total)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, total, opt_state, lr, t)
+            zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            if check_finite:
+                flags = {"loss": jnp.isfinite(loss)}
+                for key_, g in total.items():
+                    flags["grad:" + key_] = jnp.isfinite(g).all()
+                return new_params, new_bufs, new_opt, zero, loss, flags
+            return new_params, new_bufs, new_opt, zero, loss
+
+        return step
+
+    def _call_accum(self, flat, treedef, check):
+        """Gradient-merge path: k-1 accumulate-only microsteps, then one
+        accumulate+update microstep."""
+        if self._acc_grads is None:
+            self._acc_grads = jax.tree_util.tree_map(
+                jnp.zeros_like, self.params)
+        key = make_rng("train_step")
+        self._micro_count += 1
+        is_update = self._micro_count % self.grad_accum_steps == 0
+        if not is_update:
+            sig = ("acc", _sig_of(flat)[0], treedef)
+            jitted = self._jitted.get(sig)
+            if jitted is None:
+                fn = self._make_accum_step(treedef)
+                jitted = jax.jit(fn, donate_argnums=(2,)
+                                 if self._donate else ())
+                self._jitted[sig] = jitted
+            self.buffers, self._acc_grads, loss = jitted(
+                self.params, self.buffers, self._acc_grads, key, flat)
+            return Tensor(loss)
+        self.step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.step_count, jnp.int32)
+        sig = ("apply", _sig_of(flat)[0], treedef, check)
+        jitted = self._jitted.get(sig)
+        if jitted is None:
+            fn = self._make_apply_step(treedef, check_finite=check)
+            jitted = jax.jit(fn, donate_argnums=(0, 2, 3)
+                             if self._donate else ())
+            self._jitted[sig] = jitted
+        out = jitted(self.params, self.buffers, self.opt_state,
+                     self._acc_grads, lr, t, key, flat)
+        if check:
+            (self.params, self.buffers, self.opt_state, self._acc_grads,
+             loss, flags) = out
+            bad = [k_ for k_, ok in flags.items() if not bool(ok)]
+            if bad:
+                raise RuntimeError(
+                    f"NaN/Inf detected at step {self.step_count} in: "
+                    f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
+        else:
+            (self.params, self.buffers, self.opt_state, self._acc_grads,
+             loss) = out
+        return Tensor(loss)
+
     def __call__(self, *batch):
         from ..core.flags import get_flag
         raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         raw = self._place_batch(raw)
         flat, treedef = jax.tree_util.tree_flatten(raw)
         check = bool(get_flag("check_nan_inf"))
+        if self.grad_accum_steps > 1:
+            return self._call_accum(flat, treedef, check)
         sig = (_sig_of(flat)[0], treedef, check)
         jitted = self._jitted.get(sig)
         if jitted is None:
@@ -434,6 +548,10 @@ class TrainStep:
             self.buffers = {k: jnp.asarray(v)
                             for k, v in state["buffers"].items()}
         self.step_count = int(state["step_count"])
+        # restore starts a fresh gradient-accumulation window: a partial
+        # accumulator from before the restore must never leak in
+        self._acc_grads = None
+        self._micro_count = 0
         if state.get("rng_state") is not None:
             default_generator().set_state(state["rng_state"])
         if state.get("lr") is not None and hasattr(self.optimizer, "set_lr"):
@@ -450,6 +568,18 @@ class TrainStep:
     def load(self, path: str):
         from ..framework.io import load as fload
         self.set_state_dict(fload(path))
+
+    def save_sharded(self, path: str, asynchronous: bool = True):
+        """Sharded async checkpoint (each host writes its own shards;
+        serialization overlaps training). See distributed.checkpoint."""
+        from ..distributed import checkpoint as dckpt
+        dckpt.save_train_step(self, path, asynchronous=asynchronous)
+
+    def load_sharded(self, path: str):
+        """Restore a sharded checkpoint, resharding to this step's current
+        mesh layout (which may differ from the one saved under)."""
+        from ..distributed import checkpoint as dckpt
+        dckpt.load_train_step(self, path)
 
 
 def save(layer, path, input_spec=None, **configs):
