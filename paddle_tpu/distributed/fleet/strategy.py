@@ -81,6 +81,21 @@ class DistributedStrategy:
         cfg = self.__dict__["_config"]
         if name not in cfg:
             raise AttributeError(f"DistributedStrategy has no field {name!r}")
+        if name == "localsgd" and value:
+            raise NotImplementedError(
+                "localsgd is not implemented: LocalSGD trades gradient "
+                "allreduce frequency for staleness on slow interconnects; "
+                "on TPU the dp allreduce rides ICI inside the compiled "
+                "step, so the TPU-native answer is plain data parallelism "
+                "(optionally with strategy.gradient_merge for larger "
+                "effective batches)")
+        if name == "dgc" and value:
+            raise NotImplementedError(
+                "dgc (deep gradient compression) is not implemented: it "
+                "exists to shrink gradient traffic over slow networks; "
+                "TPU ICI allreduce bandwidth makes it counterproductive — "
+                "use data parallelism as-is, or bf16 params (amp O2) to "
+                "halve collective bytes")
         if isinstance(cfg[name], dict) and isinstance(value, dict):
             cfg[name].update(value)
         else:

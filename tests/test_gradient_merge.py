@@ -1,0 +1,97 @@
+"""Gradient merge (k-step accumulation) + rejected-strategy tests.
+
+reference: fleet/meta_optimizers/gradient_merge_optimizer.py (accumulate
+into persistent buffers, optimizer gated on step % k);
+localsgd_optimizer.py / dgc_optimizer.py are interconnect optimizations
+that are counterproductive on ICI and must fail loudly, not no-op.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.nn import functional as F
+from paddle_tpu.optimizer import SGD
+
+
+def _model_and_data():
+    paddle.seed(21)
+    model = nn.Linear(8, 4)
+
+    def loss_fn(layer, x, y):
+        return ((layer(x) - y) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((16, 8)).astype(np.float32)
+    ys = rng.standard_normal((16, 4)).astype(np.float32)
+    return model, loss_fn, xs, ys
+
+
+def test_four_microsteps_equal_one_big_batch():
+    """k_steps=4 with avg: four quarter-batches produce EXACTLY the update
+    of one step on the full batch (mean-reduced loss, SGD)."""
+    model, loss_fn, xs, ys = _model_and_data()
+    w0 = {k: np.asarray(p._data) for k, p in model.named_parameters()}
+
+    step = TrainStep(model, loss_fn, SGD(learning_rate=0.1),
+                     grad_accum_steps=4)
+    for i in range(4):
+        step(Tensor(xs[i * 4:(i + 1) * 4]), Tensor(ys[i * 4:(i + 1) * 4]))
+    merged = {k: np.asarray(v) for k, v in step.params.items()}
+    assert step.step_count == 1      # ONE optimizer step for 4 microsteps
+
+    # reference: single big-batch step from the same init
+    model2, loss_fn2, _, _ = _model_and_data()
+    for k, p in model2.named_parameters():
+        p._data = w0[k]
+    big = TrainStep(model2, loss_fn2, SGD(learning_rate=0.1))
+    big(Tensor(xs), Tensor(ys))
+    for k, v in big.params.items():
+        np.testing.assert_allclose(merged[k], np.asarray(v),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_no_update_until_kth_microstep():
+    model, loss_fn, xs, ys = _model_and_data()
+    w0 = {k: np.asarray(p._data) for k, p in model.named_parameters()}
+    step = TrainStep(model, loss_fn, SGD(learning_rate=0.1),
+                     grad_accum_steps=3)
+    for i in range(2):
+        step(Tensor(xs[:4]), Tensor(ys[:4]))
+        for k, v in step.params.items():
+            np.testing.assert_array_equal(np.asarray(v), w0[k])
+    step(Tensor(xs[:4]), Tensor(ys[:4]))
+    assert any(not np.array_equal(np.asarray(v), w0[k])
+               for k, v in step.params.items())
+
+
+def test_strategy_gradient_merge_wires_trainstep():
+    """strategy.gradient_merge=True + k_steps flows into TrainStep via
+    fleet (the dead-config-key fix: setting it changes semantics)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    model, loss_fn, xs, ys = _model_and_data()
+    step = TrainStep(model, loss_fn, SGD(learning_rate=0.1))
+    assert step.grad_accum_steps == 4
+    w0 = {k: np.asarray(p._data) for k, p in model.named_parameters()}
+    step(Tensor(xs[:4]), Tensor(ys[:4]))
+    for k, v in step.params.items():        # first microstep: no update
+        np.testing.assert_array_equal(np.asarray(v), w0[k])
+
+
+def test_localsgd_and_dgc_raise():
+    strategy = fleet.DistributedStrategy()
+    with pytest.raises(NotImplementedError, match="LocalSGD"):
+        strategy.localsgd = True
+    with pytest.raises(NotImplementedError, match="gradient compression"):
+        strategy.dgc = True
+    # setting False stays a no-op (config parity)
+    strategy.localsgd = False
+    strategy.dgc = False
