@@ -1,7 +1,11 @@
-"""Text datasets (reference parity: python/paddle/text/__init__.py)."""
+"""Text datasets + tokenizer (reference parity:
+python/paddle/text/__init__.py; tokenizer: faster_tokenizer_op)."""
 
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
                        UCIHousing, WMT14, WMT16)
+from .tokenizer import (BasicTokenizer, FasterTokenizer,  # noqa: F401
+                        WordpieceTokenizer, load_vocab)
 
 __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
-           "WMT14", "WMT16"]
+           "WMT14", "WMT16", "BasicTokenizer", "FasterTokenizer",
+           "WordpieceTokenizer", "load_vocab"]

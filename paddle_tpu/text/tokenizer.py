@@ -1,0 +1,231 @@
+"""BERT wordpiece tokenizer — host-side, fixed-shape, jit-ready output.
+
+reference parity: paddle/fluid/operators/string/faster_tokenizer_op.h —
+BasicTokenizer(:46), WordPieceTokenizer(:57), BertTokenizer(:71) with
+BatchEncode(:97); exposed in the reference as the faster_tokenizer op
+taking string tensors.
+
+TPU-native design: strings never touch the device. Tokenization runs on
+host CPU (the one place it can), and the tokenizer emits PADDED,
+FIXED-SHAPE int32 arrays (input_ids, token_type_ids, attention mask) so
+every batch hits the same compiled executable — the XLA analogue of the
+reference fusing tokenization into the graph. Drop the output straight
+into a jitted TrainStep or the DataLoader's collate path.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "FasterTokenizer",
+           "load_vocab"]
+
+
+def load_vocab(path: str) -> Dict[str, int]:
+    """One token per line -> {token: index} (BERT vocab.txt format)."""
+    vocab: Dict[str, int] = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def _is_whitespace(ch: str) -> bool:
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in "\t\n\r":
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII ranges treated as punctuation even when unicode disagrees
+    # (e.g. '$', '`'): the BERT convention
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting + optional lowercasing with
+    accent stripping (reference: faster_tokenizer_op.h:46)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        # strip control chars, normalize whitespace, space out CJK
+        chars = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_cjk(cp):
+                chars.extend((" ", ch, " "))
+            elif _is_whitespace(ch):
+                chars.append(" ")
+            else:
+                chars.append(ch)
+        tokens = []
+        for word in "".join(chars).split():
+            if self.do_lower_case:
+                word = word.lower()
+                word = "".join(c for c in unicodedata.normalize("NFD", word)
+                               if unicodedata.category(c) != "Mn")
+            # split on punctuation
+            cur: List[str] = []
+            for ch in word:
+                if _is_punctuation(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword split (reference:
+    faster_tokenizer_op.h:57)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            out.append(piece)
+            start = end
+        return out
+
+
+class FasterTokenizer:
+    """BERT tokenizer emitting fixed-shape, padded int32 batches.
+
+    reference: faster_tokenizer_op.h BertTokenizer(:71) — the in-graph
+    string op; here a host-side callable whose output arrays feed jit
+    directly. Accepts a vocab dict or a vocab.txt path.
+
+    Call with a string / list of strings (and optional ``text_pair``);
+    returns a dict of numpy int32 arrays ``input_ids``,
+    ``token_type_ids`` and float32 ``attention_mask`` shaped
+    [batch, max_seq_len].
+    """
+
+    def __init__(self, vocab: Union[Dict[str, int], str],
+                 do_lower_case: bool = True, unk_token: str = "[UNK]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 cls_token: str = "[CLS]", mask_token: str = "[MASK]"):
+        self.vocab = (load_vocab(vocab) if isinstance(vocab, str)
+                      else dict(vocab))
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        self.unk_token, self.sep_token = unk_token, sep_token
+        self.pad_token, self.cls_token = pad_token, cls_token
+        self.mask_token = mask_token
+        for tok in (unk_token, sep_token, pad_token, cls_token):
+            if tok not in self.vocab:
+                raise ValueError(f"special token {tok!r} not in vocab")
+        self.pad_id = self.vocab[pad_token]
+        self.cls_id = self.vocab[cls_token]
+        self.sep_id = self.vocab[sep_token]
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> List[str]:
+        return [p for w in self.basic.tokenize(text)
+                for p in self.wordpiece.tokenize(w)]
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def _encode_one(self, text: str, pair: Optional[str],
+                    max_seq_len: int) -> Tuple[List[int], List[int]]:
+        ids = self.convert_tokens_to_ids(self.tokenize(text))
+        pair_ids = (self.convert_tokens_to_ids(self.tokenize(pair))
+                    if pair is not None else None)
+        # truncate longest-first to fit specials (reference:
+        # TruncateSequence, :89)
+        n_special = 3 if pair_ids is not None else 2
+        if pair_ids is None:
+            ids = ids[:max_seq_len - n_special]
+        else:
+            while len(ids) + len(pair_ids) > max_seq_len - n_special:
+                if len(ids) >= len(pair_ids):
+                    ids.pop()
+                else:
+                    pair_ids.pop()
+        out = [self.cls_id] + ids + [self.sep_id]
+        types = [0] * len(out)
+        if pair_ids is not None:
+            out += pair_ids + [self.sep_id]
+            types += [1] * (len(pair_ids) + 1)
+        return out, types
+
+    def __call__(self, text: Union[str, Sequence[str]],
+                 text_pair: Optional[Union[str, Sequence[str]]] = None,
+                 max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = True) -> Dict[str, np.ndarray]:
+        texts = [text] if isinstance(text, str) else list(text)
+        pairs: List[Optional[str]]
+        if text_pair is None:
+            pairs = [None] * len(texts)
+        else:
+            pairs = ([text_pair] if isinstance(text_pair, str)
+                     else list(text_pair))
+        if len(pairs) != len(texts):
+            raise ValueError("text_pair batch size mismatch")
+
+        encoded = [self._encode_one(t, p, max_seq_len)
+                   for t, p in zip(texts, pairs)]
+        width = (max_seq_len if pad_to_max_seq_len
+                 else max(len(ids) for ids, _ in encoded))
+        input_ids = np.full((len(texts), width), self.pad_id, np.int32)
+        token_type = np.zeros((len(texts), width), np.int32)
+        mask = np.zeros((len(texts), width), np.float32)
+        for i, (ids, types) in enumerate(encoded):
+            input_ids[i, :len(ids)] = ids
+            token_type[i, :len(types)] = types
+            mask[i, :len(ids)] = 1.0
+        return {"input_ids": input_ids, "token_type_ids": token_type,
+                "attention_mask": mask}
