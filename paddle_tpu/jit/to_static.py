@@ -12,6 +12,7 @@ Design (vs reference program_translator.py:768):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import weakref
 from typing import Any, Callable, Dict, Optional
@@ -31,6 +32,35 @@ def _sig_of(arrays):
     leaves, treedef = jax.tree_util.tree_flatten(arrays)
     return (tuple((a.shape, str(a.dtype)) if hasattr(a, "shape") else (type(a), a)
                   for a in leaves), treedef)
+
+
+_CONTROL_FLOW_GUIDANCE = (
+    "\n\nThis happened while compiling (tracing) the model: python "
+    "control flow branched on a TRACED tensor value, which has no "
+    "concrete value at compile time (reference analogue: the AST "
+    "translator of program_translator.py rewrites `if`/`while` on "
+    "tensors into conditional_block/while ops). The TPU-native fixes:\n"
+    "  - paddle.static.nn.cond(pred, true_fn, false_fn) for tensor-"
+    "dependent branches (compiles both, selects on device);\n"
+    "  - paddle.static.nn.while_loop(cond_fn, body_fn, vars) for "
+    "tensor-dependent loops;\n"
+    "  - jnp.where / paddle.where for elementwise selects;\n"
+    "  - move the branch decision to host data (python scalars) if it "
+    "is static per call."
+)
+
+
+@contextlib.contextmanager
+def _control_flow_guidance():
+    """Append framework guidance to tracer-concretization errors (the
+    exception object is re-raised with an amended message so user
+    except-clauses keep matching the jax type)."""
+    import jax.errors
+    try:
+        yield
+    except jax.errors.ConcretizationTypeError as e:
+        e.args = (str(e) + _CONTROL_FLOW_GUIDANCE,)
+        raise
 
 
 class StaticFunction:
@@ -90,7 +120,9 @@ class StaticFunction:
                              for p in layer.parameters())
 
         if not needs_grad:
-            out_arrays, new_bufs = jitted(p_arrays, b_arrays, key, flat_inputs)
+            with _control_flow_guidance():
+                out_arrays, new_bufs = jitted(p_arrays, b_arrays, key,
+                                              flat_inputs)
             if layer is not None:
                 for k, b in layer.named_buffers():
                     if k in new_bufs:
@@ -105,7 +137,9 @@ class StaticFunction:
 
         pure = self._pure(treedef, kwargs)
 
-        out_arrays, new_bufs = jitted(p_arrays, b_arrays, key, flat_inputs)
+        with _control_flow_guidance():
+            out_arrays, new_bufs = jitted(p_arrays, b_arrays, key,
+                                          flat_inputs)
 
         bwd = self._bwd_cache.get(sig)
         if bwd is None:
@@ -406,8 +440,9 @@ class TrainStep:
                 jitted = jax.jit(fn, donate_argnums=(2,)
                                  if self._donate else ())
                 self._jitted[sig] = jitted
-            self.buffers, self._acc_grads, loss = jitted(
-                self.params, self.buffers, self._acc_grads, key, flat)
+            with _control_flow_guidance():
+                self.buffers, self._acc_grads, loss = jitted(
+                    self.params, self.buffers, self._acc_grads, key, flat)
             return Tensor(loss)
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -419,8 +454,9 @@ class TrainStep:
             jitted = jax.jit(fn, donate_argnums=(0, 2, 3)
                              if self._donate else ())
             self._jitted[sig] = jitted
-        out = jitted(self.params, self.buffers, self.opt_state,
-                     self._acc_grads, lr, t, key, flat)
+        with _control_flow_guidance():
+            out = jitted(self.params, self.buffers, self.opt_state,
+                         self._acc_grads, lr, t, key, flat)
         if check:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss, flags) = out
@@ -453,8 +489,9 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = make_rng("train_step")
-        out = jitted(self.params, self.buffers, self.opt_state, lr, t, key,
-                     flat)
+        with _control_flow_guidance():
+            out = jitted(self.params, self.buffers, self.opt_state, lr, t,
+                         key, flat)
         if check:
             self.params, self.buffers, self.opt_state, loss, flags = out
             bad = [k for k, ok in flags.items() if not bool(ok)]

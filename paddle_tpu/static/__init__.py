@@ -19,6 +19,8 @@ from ..core import dtypes
 from ..core.tensor import Tensor
 from ..jit.input_spec import InputSpec
 
+from . import nn  # noqa: F401,E402  (functional control flow: cond/while_loop)
+
 _static_mode = [False]
 
 
@@ -147,20 +149,5 @@ class Executor:
         return [Tensor(o) for o in outs]
 
 
-# nn facade for static-style layer helpers
-class _StaticNN:
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
-        from ..nn import Linear
-        from ..nn import functional as F
-        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
-        layer = Linear(in_dim, size)
-        from ..tensor.manipulation import reshape
-        flat = reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_dim,))
-        out = layer(flat)
-        if activation:
-            out = getattr(F, activation)(out)
-        return out
-
-
-nn = _StaticNN()
+# static-style layer helpers + functional control flow live in static.nn
+# (imported at module top)

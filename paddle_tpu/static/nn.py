@@ -1,0 +1,143 @@
+"""Functional control flow over traced tensors.
+
+reference parity: paddle/fluid/layers/control_flow.py cond(:2323),
+while_loop(:1045), case/switch_case — backed by
+operators/controlflow/conditional_block_op.cc and while_op.cc (sub-block
+programs executed by the interpreter).
+
+TPU-native design: data-dependent control flow must stay INSIDE the
+compiled program (a host round-trip per branch would stall the TPU), so
+these map 1:1 onto XLA's native control ops — ``lax.cond`` /
+``lax.while_loop`` / ``lax.switch``. Both branches are compiled; the
+predicate selects on device. Python ``if tensor:`` raises a guided error
+instead (see jit.to_static) because tracing cannot see concrete values.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..jit.functional import unwrap, wrap
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "fc"]
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+    """Static-style fully-connected helper (reference: fluid/layers/nn.py
+    fc): flattens trailing dims, creates a fresh Linear, optional
+    activation by name."""
+    import numpy as np
+
+    from ..nn import Linear
+    from ..nn import functional as F
+    from ..tensor.manipulation import reshape
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_dim, size)
+    flat = reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_dim,))
+    out = layer(flat)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def _as_scalar_pred(pred):
+    p = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if p.ndim:
+        p = p.reshape(())
+    return p.astype(bool)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """Run true_fn() or false_fn() selected by a traced boolean scalar.
+
+    reference: control_flow.py:2323 cond (conditional_block sub-programs).
+    Both branches are traced/compiled; XLA executes the selected one on
+    device. Branch outputs must match in structure/shape/dtype.
+    Extra ``operands`` are passed to both branches (closure capture also
+    works, as in the reference).
+    """
+    raw = [o._data if isinstance(o, Tensor) else o for o in operands]
+
+    def tb(ops):
+        return unwrap(true_fn(*wrap(list(ops))))
+
+    def fb(ops):
+        return unwrap(false_fn(*wrap(list(ops))))
+
+    out = jax.lax.cond(_as_scalar_pred(pred), tb, fb, tuple(raw))
+    return wrap(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
+    """reference: control_flow.py:1045 while_loop (while_op sub-program).
+    Maps to lax.while_loop: carried values must keep shape/dtype; the
+    condition returns a scalar bool tensor."""
+    is_seq = isinstance(loop_vars, (list, tuple))
+    seq: Sequence = loop_vars if is_seq else [loop_vars]
+    raw = tuple(v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                for v in seq)
+
+    def c(vals):
+        out = cond_fn(*wrap(list(vals)))
+        return _as_scalar_pred(out)
+
+    def b(vals):
+        out = body_fn(*wrap(list(vals)))
+        out_seq = out if isinstance(out, (list, tuple)) else [out]
+        if len(out_seq) != len(vals):
+            raise ValueError(
+                f"while_loop body returned {len(out_seq)} values, "
+                f"expected {len(vals)} (loop_vars structure must be "
+                "invariant)")
+        return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in out_seq)
+
+    out = jax.lax.while_loop(c, b, raw)
+    wrapped = [wrap(o) for o in out]
+    return wrapped if is_seq else wrapped[0]
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None):
+    """First-match-wins branch list (reference: control_flow.py case).
+    Lowered as a chain of lax.cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(pairs):
+        (pred, fn), rest = pairs[0], pairs[1:]
+        if rest:
+            return cond(pred, fn, lambda: build(rest))
+        if default is not None:
+            return cond(pred, fn, default)
+        return fn()
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None):
+    """Integer-indexed branch select (reference: control_flow.py
+    switch_case) -> lax.switch."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        if keys != list(range(len(keys))):
+            raise ValueError(
+                "switch_case branch_fns keys must be 0..N-1 for the "
+                "dense lax.switch lowering; pad missing indices with "
+                "the default fn")
+        fns: List[Callable] = [branch_fns[k] for k in keys]
+    else:
+        fns = list(branch_fns)
+    if default is not None:
+        fns = fns + [default]
+    idx = branch_index._data if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    idx = idx.reshape(()).astype(jnp.int32)
+    if default is not None:
+        idx = jnp.where((idx < 0) | (idx >= len(fns) - 1),
+                        len(fns) - 1, idx)
+    out = jax.lax.switch(idx, [lambda f=f: unwrap(f()) for f in fns])
+    return wrap(out)

@@ -1,0 +1,126 @@
+"""Functional control flow (static.nn) + guided tracing errors.
+
+reference parity: fluid/layers/control_flow.py cond(:2323)/while_loop
+(:1045) over conditional_block_op/while_op; the AST translator
+(program_translator.py:768) handles python `if`/`while` on tensors —
+here the python form raises a GUIDED error pointing at the functional
+API (tests at bottom).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_cond_selects_branch():
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    big = static.nn.cond(x.sum() > 2.0, lambda: x * 2, lambda: x - 1)
+    small = static.nn.cond(x.sum() > 5.0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(np.asarray(big._data), [6.0])
+    np.testing.assert_allclose(np.asarray(small._data), [2.0])
+
+
+def test_cond_with_operands_under_jit():
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return static.nn.cond(x.sum() > 0, lambda t: t + 1,
+                              lambda t: t - 1, x)
+
+    out = f(paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [2.0, 2.0])
+    out = f(paddle.to_tensor(-np.ones((2,), np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [-2.0, -2.0])
+
+
+def test_while_loop_accumulates():
+    i = paddle.to_tensor(np.asarray(0, np.int32))
+    s = paddle.to_tensor(np.asarray(0.0, np.float32))
+
+    i_out, s_out = static.nn.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (i + 1, s + 2.0),
+        [i, s])
+    assert int(np.asarray(i_out._data)) == 5
+    assert float(np.asarray(s_out._data)) == 10.0
+
+
+def test_while_loop_structure_mismatch_raises():
+    with pytest.raises(ValueError, match="invariant"):
+        static.nn.while_loop(lambda i: i < 3, lambda i: (i + 1, i),
+                             paddle.to_tensor(np.asarray(0, np.int32)))
+
+
+def test_switch_case_and_case():
+    idx = paddle.to_tensor(np.asarray(1, np.int32))
+    out = static.nn.switch_case(idx, [
+        lambda: paddle.to_tensor(np.asarray(10.0, np.float32)),
+        lambda: paddle.to_tensor(np.asarray(20.0, np.float32)),
+    ], default=lambda: paddle.to_tensor(np.asarray(-1.0, np.float32)))
+    assert float(np.asarray(out._data)) == 20.0
+    out = static.nn.switch_case(
+        paddle.to_tensor(np.asarray(7, np.int32)), [
+            lambda: paddle.to_tensor(np.asarray(10.0, np.float32)),
+            lambda: paddle.to_tensor(np.asarray(20.0, np.float32)),
+        ], default=lambda: paddle.to_tensor(np.asarray(-1.0, np.float32)))
+    assert float(np.asarray(out._data)) == -1.0
+
+    x = paddle.to_tensor(np.asarray(4.0, np.float32))
+    out = static.nn.case(
+        [(x > 10.0, lambda: x * 1),
+         (x > 2.0, lambda: x * 10)],
+        default=lambda: x * 100)
+    assert float(np.asarray(out._data)) == 40.0
+
+
+def test_model_with_cond_compiles():
+    """A model whose forward uses the functional API compiles under
+    to_static (the 'data-dependent branch compiles' criterion)."""
+    from paddle_tpu.jit import to_static
+
+    class Gated(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return static.nn.cond(h.mean() > 0,
+                                  lambda: h * 2.0, lambda: h * 0.5)
+
+    paddle.seed(0)
+    model = Gated()
+    model.eval()
+    f = to_static(model)
+    out = model(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert np.isfinite(np.asarray(out._data)).all()
+
+
+def test_python_if_on_tensor_raises_guided_error():
+    """Python `if tensor:` inside a traced forward fails with framework
+    guidance naming static.nn.cond (not a bare jax error)."""
+    from paddle_tpu.jit import to_static
+
+    class Bad(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:     # traced bool -> concretization error
+                return h * 2
+            return h
+
+    import jax.errors
+    paddle.seed(0)
+    model = Bad()
+    model.eval()
+    to_static(model)
+    with pytest.raises(jax.errors.ConcretizationTypeError,
+                       match="static.nn.cond"):
+        model(paddle.to_tensor(np.ones((2, 4), np.float32)))
