@@ -98,6 +98,12 @@ from .distributed.parallel import DataParallel  # noqa: F401
 # `paddle.nn.functional` style import convenience
 from .nn import functional as _F  # noqa: F401
 
+# Persistent XLA compilation cache (FLAGS_compilation_cache, on by
+# default): warm starts skip the 20-40s first compile. User scripts get
+# this without opting in; FLAGS_compilation_cache=0 disables.
+from .core.flags import apply_compilation_cache as _apply_cc  # noqa: E402
+_apply_cc()
+
 
 def disable_static(place=None):
     """Parity shim: this framework is always eager-first."""

@@ -377,11 +377,66 @@ def _unwrap_index(idx):
 # ---------------------------------------------------------------------------
 
 _amp_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
+_op_profile_hook: Optional[Callable] = None  # installed by paddle_tpu.profiler
+
+# Eager-op jit cache (FLAGS_eager_jit_ops, reference analogue: the op-cache
+# the reference's dygraph tracer maintains per op+sig, imperative/
+# tracer.cc:146). The tape path's jax.vjp re-TRACES the op every call —
+# hundreds of µs of host work per op; caching a jitted forward plus a
+# jitted remat-backward keyed by (op identity, shapes, dtypes) turns hot
+# eager loops into dict lookup + dispatch. Only closure-free fns are
+# cacheable (a closure's captured values are invisible to the key); the
+# cache holds a strong ref to fn so id() cannot be reused while cached,
+# and is LRU-bounded.
+import collections as _collections
+
+_EAGER_FN_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+_EAGER_FN_CACHE_MAX = 1024
+
+
+def _eager_cacheable(fn, static_kw) -> bool:
+    if getattr(fn, "__closure__", None) is not None:
+        return False
+    # inline lambdas / local defs get a FRESH id() per call site execution:
+    # caching them is all misses + LRU churn; only stable module-level
+    # callables qualify
+    if "<locals>" in getattr(fn, "__qualname__", ""):
+        return False
+    if static_kw:
+        try:
+            hash(tuple(sorted(static_kw.items())))
+        except TypeError:
+            return False
+    return True
+
+
+def _eager_cache_get(key):
+    ent = _EAGER_FN_CACHE.get(key)
+    if ent is not None:
+        try:
+            _EAGER_FN_CACHE.move_to_end(key)
+        except KeyError:
+            pass           # concurrently evicted; ent is still usable
+    return ent
+
+
+def _eager_cache_put(key, ent):
+    _EAGER_FN_CACHE[key] = ent
+    if len(_EAGER_FN_CACHE) > _EAGER_FN_CACHE_MAX:
+        _EAGER_FN_CACHE.popitem(last=False)
 
 
 def set_amp_hook(fn):
     global _amp_hook
     _amp_hook = fn
+
+
+def set_op_profile_hook(fn):
+    """Install/remove (None) the eager per-op timing hook: called with
+    (op_name, seconds) after every apply() — the dygraph analogue of the
+    reference's RecordEvent-per-op in imperative/tracer.cc."""
+    global _op_profile_hook
+    _op_profile_hook = fn
 
 
 def apply(fn: Callable, *args, name: str = "", **static_kw):
@@ -403,6 +458,13 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
                 break
 
     if not record:
+        if _op_profile_hook is not None and not any(
+                _is_tracer(a) for a in raw):
+            import time as _time
+            t0 = _time.perf_counter()
+            out = fn(*raw, **static_kw) if static_kw else fn(*raw)
+            _op_profile_hook(name or "unnamed", _time.perf_counter() - t0)
+            return _wrap_outputs(out, node=None)
         out = fn(*raw, **static_kw) if static_kw else fn(*raw)
         return _wrap_outputs(out, node=None)
 
@@ -419,7 +481,56 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
             vals[i] = v
         return fn(*vals, **static_kw) if static_kw else fn(*vals)
 
-    primals, vjp_fn = jax.vjp(fn_diff, *(raw[i] for i in diff_idx))
+    t0 = None
+    if _op_profile_hook is not None:
+        import time as _time
+        t0 = _time.perf_counter()
+
+    cached = None
+    if get_flag("eager_jit_ops") and _eager_cacheable(fn, static_kw) \
+            and all(hasattr(a, "shape") for a in raw):
+        # all-array args only: jitting would trace positional python
+        # scalars that the fn may use structurally (axis/shape values)
+        try:
+            key = (id(fn), name, tuple(diff_idx),
+                   tuple((a.shape, str(a.dtype)) for a in raw),
+                   tuple(sorted(static_kw.items())) if static_kw else ())
+            hash(key)
+        except TypeError:
+            key = None
+        cached = _eager_cache_get(key) if key is not None else None
+        if cached is None and key is not None:
+            def fwd_fn(vals):
+                return fn(*vals, **static_kw) if static_kw else fn(*vals)
+
+            def bwd_fn(vals, cots):
+                def f(*dv):
+                    vs = list(vals)
+                    for i, v in zip(diff_idx, dv):
+                        vs[i] = v
+                    return fn(*vs, **static_kw) if static_kw else fn(*vs)
+                _, vjp = jax.vjp(f, *(vals[i] for i in diff_idx))
+                return vjp(cots)
+
+            cached = (fn, jax.jit(fwd_fn), jax.jit(bwd_fn))
+            _eager_cache_put(key, cached)
+
+    if cached is not None:
+        # cached path: jitted forward now; backward (forward remat inside
+        # one compiled call — cheap for elementary ops) deferred until the
+        # tape actually needs it
+        _, fwd_jit, bwd_jit = cached
+        primals = fwd_jit(tuple(raw))
+        captured_raw = tuple(raw)
+
+        def vjp_fn(cots):
+            return bwd_jit(captured_raw, cots)
+    else:
+        primals, vjp_fn = jax.vjp(fn_diff, *(raw[i] for i in diff_idx))
+
+    if t0 is not None:
+        import time as _time
+        _op_profile_hook(name or "unnamed", _time.perf_counter() - t0)
 
     flat = primals if isinstance(primals, (tuple, list)) else (primals,)
     out_avals = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in flat]
