@@ -1,0 +1,202 @@
+"""Sequence operators over (padded, lengths) pairs.
+
+reference parity: paddle/fluid/operators/sequence_ops/ —
+sequence_pad_op, sequence_unpad_op, sequence_pool_op (SUM/AVERAGE/MAX/
+SQRT/FIRST/LAST), sequence_reverse_op, sequence_softmax_op,
+sequence_expand_as_op, sequence_enumerate_op, sequence_mask_op,
+sequence_concat_op — all defined over LoD (ragged level-0) tensors.
+
+TPU-native design: XLA requires static shapes, so ragged sequences are
+carried as a PADDED batch [B, S, ...] plus an int lengths vector [B] —
+exactly what sequence_pad produces from the reference's LoD input, and
+what every production TPU text pipeline feeds. Each op consumes/produces
+that pair; masking replaces LoD offset walks, so everything jits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = ["sequence_pad", "sequence_unpad", "sequence_pool",
+           "sequence_reverse", "sequence_softmax", "sequence_expand_as",
+           "sequence_enumerate", "sequence_concat"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _len_arr(lengths):
+    return (lengths._data if isinstance(lengths, Tensor)
+            else jnp.asarray(lengths)).astype(jnp.int32)
+
+
+def sequence_pad(sequences, pad_value=0.0, maxlen: Optional[int] = None,
+                 dtype=None):
+    """List of ragged [L_i, ...] arrays -> (padded [B, S, ...],
+    lengths [B]) (reference: sequence_pad_op — LoD in, padded out).
+    Host-side by nature (ragged input cannot live on device)."""
+    arrs = [np.asarray(s._data if isinstance(s, Tensor) else s)
+            for s in sequences]
+    lens = np.asarray([a.shape[0] for a in arrs], np.int32)
+    S = int(maxlen if maxlen is not None else lens.max(initial=0))
+    if lens.size and S < lens.max():
+        raise ValueError(f"maxlen {S} < longest sequence {lens.max()}")
+    tail = arrs[0].shape[1:] if arrs else ()
+    out = np.full((len(arrs), S) + tail, pad_value,
+                  dtype or (arrs[0].dtype if arrs else np.float32))
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0]] = a
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lens))
+
+
+def sequence_unpad(x, lengths) -> List[Tensor]:
+    """(padded, lengths) -> list of ragged tensors (reference:
+    sequence_unpad_op). Host-side: ragged output."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    lens = np.asarray(lengths._data if isinstance(lengths, Tensor)
+                      else lengths)
+    return [Tensor(jnp.asarray(arr[i, :int(l)]))
+            for i, l in enumerate(lens)]
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum"):
+    """Masked pool over the sequence dim (reference: sequence_pool_op —
+    SUM/AVERAGE/MAX/SQRT/FIRST/LAST). x [B, S, ...], lengths [B] ->
+    [B, ...]; empty sequences pool to 0."""
+    x = _t(x)
+    pool = pool_type.lower()
+    if pool not in ("sum", "average", "mean", "max", "sqrt", "first",
+                    "last"):
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    def impl(a, ln):
+        S = a.shape[1]
+        mask = (jnp.arange(S)[None, :] < ln[:, None])
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - 2))
+        af = a.astype(jnp.float32) if pool in ("average", "mean", "sqrt") \
+            else a
+        if pool == "max":
+            neg = jnp.finfo(a.dtype).min if jnp.issubdtype(
+                a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            out = jnp.where(m, a, neg).max(axis=1)
+            return jnp.where((ln > 0).reshape((-1,) + (1,) * (a.ndim - 2)),
+                             out, jnp.zeros_like(out))
+        if pool == "first":
+            return jnp.where(
+                (ln > 0).reshape((-1,) + (1,) * (a.ndim - 2)),
+                a[:, 0], jnp.zeros_like(a[:, 0]))
+        if pool == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                a, idx.reshape((-1, 1) + (1,) * (a.ndim - 2)), axis=1
+            )[:, 0]
+            return jnp.where(
+                (ln > 0).reshape((-1,) + (1,) * (a.ndim - 2)),
+                out, jnp.zeros_like(out))
+        total = jnp.where(m, af, 0).sum(axis=1)
+        if pool == "sum":
+            return total.astype(a.dtype)
+        denom = jnp.maximum(ln, 1).astype(jnp.float32)
+        denom = denom.reshape((-1,) + (1,) * (total.ndim - 1))
+        if pool in ("average", "mean"):
+            return (total / denom).astype(a.dtype)
+        return (total / jnp.sqrt(denom)).astype(a.dtype)   # sqrt
+
+    return apply(impl, x, Tensor(_len_arr(lengths)),
+                 name=f"sequence_pool_{pool}")
+
+
+def sequence_reverse(x, lengths):
+    """Reverse each sequence in place, padding stays at the tail
+    (reference: sequence_reverse_op)."""
+    x = _t(x)
+
+    def impl(a, ln):
+        S = a.shape[1]
+        pos = jnp.arange(S)[None, :]
+        idx = jnp.where(pos < ln[:, None], ln[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            a, idx.reshape(idx.shape + (1,) * (a.ndim - 2)), axis=1)
+
+    return apply(impl, x, Tensor(_len_arr(lengths)),
+                 name="sequence_reverse")
+
+
+def sequence_softmax(x, lengths):
+    """Per-sequence masked softmax (reference: sequence_softmax_op).
+    x [B, S], padding positions get 0."""
+    x = _t(x)
+
+    def impl(a, ln):
+        mask = jnp.arange(a.shape[1])[None, :] < ln[:, None]
+        z = jnp.where(mask, a, -jnp.inf)
+        out = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, out, 0.0)
+
+    return apply(impl, x, Tensor(_len_arr(lengths)),
+                 name="sequence_softmax")
+
+
+def sequence_expand_as(x, lengths):
+    """Broadcast one row per sequence across its timesteps (reference:
+    sequence_expand_as_op): x [B, ...] -> [B, S, ...] masked to
+    lengths, with S = max length."""
+    x = _t(x)
+    # static-shape requirement: the padded width is resolved on host from
+    # concrete lengths (XLA cannot size an output from traced values)
+    ln = np.asarray(_len_arr(lengths))
+    S = int(ln.max(initial=0))
+
+    def impl2(a, ln_):
+        rep = jnp.repeat(a[:, None], S, axis=1)
+        mask = jnp.arange(S)[None, :] < ln_[:, None]
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+        return jnp.where(m, rep, jnp.zeros_like(rep))
+
+    return apply(impl2, x, Tensor(_len_arr(lengths)),
+                 name="sequence_expand_as")
+
+
+def sequence_enumerate(x, lengths, win_size: int, pad_value: int = 0):
+    """Sliding windows of ids per sequence (reference:
+    sequence_enumerate_op): x [B, S] int -> [B, S, win_size]; positions
+    past each sequence's end (and window overhang) take pad_value."""
+    x = _t(x)
+
+    def impl(a, ln):
+        S = a.shape[1]
+        pos = jnp.arange(S)[:, None] + jnp.arange(win_size)[None, :]
+        gathered = jnp.take(a, jnp.clip(pos, 0, S - 1), axis=1)
+        valid = (pos[None] < ln[:, None, None])
+        return jnp.where(valid, gathered, pad_value)
+
+    return apply(impl, x, Tensor(_len_arr(lengths)),
+                 name="sequence_enumerate")
+
+
+def sequence_concat(xs_and_lens: Sequence[Tuple]):
+    """Concatenate corresponding sequences from multiple (padded,
+    lengths) pairs (reference: sequence_concat_op). Host-side repack —
+    output width is the sum of per-batch lengths."""
+    parts = [(np.asarray(x._data if isinstance(x, Tensor) else x),
+              np.asarray(l._data if isinstance(l, Tensor) else l))
+             for x, l in xs_and_lens]
+    B = parts[0][0].shape[0]
+    out_lens = np.sum([l for _, l in parts], axis=0).astype(np.int32)
+    S = int(out_lens.max(initial=0))
+    tail = parts[0][0].shape[2:]
+    out = np.zeros((B, S) + tail, parts[0][0].dtype)
+    for b in range(B):
+        o = 0
+        for a, l in parts:
+            n = int(l[b])
+            out[b, o:o + n] = a[b, :n]
+            o += n
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(out_lens))

@@ -496,3 +496,11 @@ def decode_jpeg(x, mode: str = "unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+# detection-head op tail (SSD priors, RPN anchors, box codec, NMS post)
+from .detection import (anchor_generator, box_coder,  # noqa: E402,F401
+                        multiclass_nms, prior_box)
+
+__all__ += ["prior_box", "anchor_generator", "box_coder",
+            "multiclass_nms"]

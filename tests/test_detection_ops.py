@@ -1,0 +1,177 @@
+"""Golden tests for the detection-head op tail.
+
+Brute-force reference loops transcribed from the C++ kernel semantics
+(prior_box_op.h, anchor_generator_op.h, box_coder_op.h,
+multiclass_nms_op.cc) — each op must match element-for-element.
+"""
+
+import math
+
+import numpy as np
+
+from paddle_tpu.vision.ops import (anchor_generator, box_coder,
+                                   multiclass_nms, prior_box)
+
+
+def _ref_prior_box(fh, fw, ih, iw, min_sizes, max_sizes, ars_in, flip,
+                   clip, offset, mm_order):
+    ars = [1.0]
+    for ar in ars_in:
+        if any(abs(ar - v) < 1e-6 for v in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    sw, sh = iw / fw, ih / fh
+    num = len(ars) * len(min_sizes) + len(max_sizes or [])
+    out = np.zeros((fh, fw, num, 4), np.float32)
+    for h in range(fh):
+        for w in range(fw):
+            cx, cy = (w + offset) * sw, (h + offset) * sh
+            k = 0
+
+            def put(bw, bh):
+                nonlocal k
+                out[h, w, k] = [(cx - bw) / iw, (cy - bh) / ih,
+                                (cx + bw) / iw, (cy + bh) / ih]
+                k += 1
+            for s, mn in enumerate(min_sizes):
+                if mm_order:
+                    put(mn / 2, mn / 2)
+                    if max_sizes:
+                        m = math.sqrt(mn * max_sizes[s]) / 2
+                        put(m, m)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        put(mn * math.sqrt(ar) / 2, mn / math.sqrt(ar) / 2)
+                else:
+                    for ar in ars:
+                        put(mn * math.sqrt(ar) / 2, mn / math.sqrt(ar) / 2)
+                    if max_sizes:
+                        m = math.sqrt(mn * max_sizes[s]) / 2
+                        put(m, m)
+    if clip:
+        out = np.clip(out, 0, 1)
+    return out
+
+
+def test_prior_box_matches_reference_math():
+    feat = np.zeros((1, 3, 6, 9), np.float32)
+    img = np.zeros((1, 3, 90, 135), np.float32)
+    for mm_order in (False, True):
+        for flip in (False, True):
+            boxes, var = prior_box(
+                feat, img, min_sizes=[20.0, 40.0], max_sizes=[30.0, 60.0],
+                aspect_ratios=[2.0, 0.5] if not flip else [2.0],
+                flip=flip, clip=True,
+                min_max_aspect_ratios_order=mm_order)
+            ref = _ref_prior_box(
+                6, 9, 90, 135, [20.0, 40.0], [30.0, 60.0],
+                [2.0, 0.5] if not flip else [2.0], flip, True, 0.5,
+                mm_order)
+            got = np.asarray(boxes._data)
+            assert got.shape == ref.shape, (got.shape, ref.shape)
+            np.testing.assert_allclose(got, ref, atol=1e-5)
+            v = np.asarray(var._data)
+            assert v.shape == ref.shape
+            np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_matches_reference_math():
+    feat = np.zeros((1, 8, 5, 7), np.float32)
+    sizes, ratios, stride = [32.0, 64.0], [0.5, 1.0, 2.0], (16.0, 16.0)
+    anchors, var = anchor_generator(feat, sizes, ratios, stride=stride)
+    got = np.asarray(anchors._data)
+    assert got.shape == (5, 7, 6, 4)
+    for h in (0, 4):
+        for w in (0, 6):
+            idx = 0
+            for ar in ratios:
+                for size in sizes:
+                    area = stride[0] * stride[1]
+                    bw = round(math.sqrt(area / ar))
+                    bh = round(bw * ar)
+                    aw = size / stride[0] * bw
+                    ah = size / stride[1] * bh
+                    xc = w * stride[0] + 0.5 * (stride[0] - 1)
+                    yc = h * stride[1] + 0.5 * (stride[1] - 1)
+                    ref = [xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                           xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)]
+                    np.testing.assert_allclose(got[h, w, idx], ref,
+                                               atol=1e-4)
+                    idx += 1
+
+
+def test_box_coder_encode_matches_reference_math():
+    rng = np.random.default_rng(0)
+    prior = np.abs(rng.standard_normal((5, 4))).astype(np.float32)
+    prior[:, 2:] += prior[:, :2] + 0.5
+    pvar = np.abs(rng.standard_normal((5, 4))).astype(np.float32) + 0.1
+    target = np.abs(rng.standard_normal((3, 4))).astype(np.float32)
+    target[:, 2:] += target[:, :2] + 0.5
+
+    out = np.asarray(box_coder(prior, pvar, target,
+                               code_type="encode_center_size")._data)
+    assert out.shape == (3, 5, 4)
+    for i in range(3):
+        for j in range(5):
+            pw = prior[j, 2] - prior[j, 0]
+            ph = prior[j, 3] - prior[j, 1]
+            pcx, pcy = prior[j, 0] + pw / 2, prior[j, 1] + ph / 2
+            tw = target[i, 2] - target[i, 0]
+            th = target[i, 3] - target[i, 1]
+            tcx = (target[i, 0] + target[i, 2]) / 2
+            tcy = (target[i, 1] + target[i, 3]) / 2
+            ref = np.array([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                            math.log(abs(tw / pw)),
+                            math.log(abs(th / ph))]) / pvar[j]
+            np.testing.assert_allclose(out[i, j], ref, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_box_coder_decode_round_trips_encode():
+    rng = np.random.default_rng(1)
+    prior = np.abs(rng.standard_normal((4, 4))).astype(np.float32)
+    prior[:, 2:] += prior[:, :2] + 0.5
+    target = np.abs(rng.standard_normal((4, 4))).astype(np.float32)
+    target[:, 2:] += target[:, :2] + 0.5
+
+    enc = box_coder(prior, [0.1, 0.1, 0.2, 0.2], target,
+                    code_type="encode_center_size")
+    # decode each target against ITS prior: take the diagonal; axis=1
+    # indexes the prior per ROW
+    enc_diag = np.asarray(enc._data)[np.arange(4), np.arange(4)][:, None, :]
+    dec = np.asarray(box_coder(prior, [0.1, 0.1, 0.2, 0.2], enc_diag,
+                               code_type="decode_center_size",
+                               axis=1)._data)
+    np.testing.assert_allclose(dec[:, 0, :], target, rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_nms_suppression_and_topk():
+    # two classes (+background 0), overlapping boxes
+    boxes = np.array([[
+        [0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],   # heavy overlap pair
+        [20, 20, 30, 30], [100, 100, 110, 110],
+    ]], np.float32)
+    scores = np.zeros((1, 3, 4), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.6, 0.05]   # class 1: pair + 1 + below-thr
+    scores[0, 2] = [0.0, 0.0, 0.7, 0.95]    # class 2
+    out, counts = multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_top_k=10, keep_top_k=5,
+                                 nms_threshold=0.5)
+    o = np.asarray(out._data)[0]
+    n = int(np.asarray(counts._data)[0])
+    # class1: box0 kept, box1 suppressed, box2 kept; class2: box3, box2
+    assert n == 4
+    # sorted by score desc: (2,0.95,box3), (1,0.9,box0), (2,0.7,box2), (1,0.6,box2)
+    np.testing.assert_allclose(o[0, :2], [2, 0.95], atol=1e-6)
+    np.testing.assert_allclose(o[1, :2], [1, 0.9], atol=1e-6)
+    np.testing.assert_allclose(o[2, :2], [2, 0.7], atol=1e-6)
+    np.testing.assert_allclose(o[3, :2], [1, 0.6], atol=1e-6)
+    assert (o[4] == -1).all()               # padding
+    # keep_top_k bound
+    out2, counts2 = multiclass_nms(boxes, scores, score_threshold=0.1,
+                                   nms_top_k=10, keep_top_k=2,
+                                   nms_threshold=0.5)
+    assert int(np.asarray(counts2._data)[0]) == 2

@@ -1,0 +1,72 @@
+"""Distribution breadth tests (Bernoulli/Multinomial/Beta/Dirichlet +
+kl_divergence) — golden values via scipy.
+
+The reference ships exactly Uniform/Normal/Categorical
+(python/paddle/distribution.py); these surpass per SURVEY §7.9.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distribution as D
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+def _f(t):
+    return float(np.asarray(t._data))
+
+
+def test_bernoulli_scipy_parity():
+    b = D.Bernoulli(0.7)
+    assert abs(_f(b.log_prob(1.0)) - np.log(0.7)) < 1e-6
+    assert abs(_f(b.log_prob(0.0)) - np.log(0.3)) < 1e-6
+    assert abs(_f(b.entropy()) - scipy_stats.bernoulli.entropy(0.7)) < 1e-6
+    s = np.asarray(b.sample((2000,), seed=1)._data)
+    assert set(np.unique(s)) <= {0.0, 1.0}
+    assert abs(s.mean() - 0.7) < 0.05
+    kl = _f(D.kl_divergence(D.Bernoulli(0.7), D.Bernoulli(0.4)))
+    ref = 0.7 * np.log(0.7 / 0.4) + 0.3 * np.log(0.3 / 0.6)
+    assert abs(kl - ref) < 1e-6
+
+
+def test_beta_scipy_parity():
+    b = D.Beta(2.0, 3.0)
+    assert abs(_f(b.log_prob(0.3))
+               - scipy_stats.beta.logpdf(0.3, 2, 3)) < 1e-5
+    assert abs(_f(b.entropy()) - scipy_stats.beta.entropy(2, 3)) < 1e-5
+    assert abs(_f(b.mean()) - 0.4) < 1e-6
+    s = np.asarray(b.sample((3000,), seed=2)._data)
+    assert ((s > 0) & (s < 1)).all()
+    assert abs(s.mean() - 0.4) < 0.03
+
+
+def test_dirichlet_scipy_parity():
+    c = np.array([1.5, 2.5, 3.0], np.float32)
+    d = D.Dirichlet(c)
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    assert abs(_f(d.log_prob(x))
+               - scipy_stats.dirichlet.logpdf(x, c)) < 1e-4
+    assert abs(_f(d.entropy())
+               - scipy_stats.dirichlet.entropy(c)) < 1e-4
+    s = np.asarray(d.sample((500,), seed=3)._data)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_multinomial_scipy_parity():
+    p = np.array([0.2, 0.3, 0.5], np.float32)
+    m = D.Multinomial(5, p)
+    cnt = np.array([1.0, 2.0, 2.0], np.float32)
+    assert abs(_f(m.log_prob(cnt))
+               - scipy_stats.multinomial.logpmf(cnt, 5, p)) < 1e-4
+    s = np.asarray(m.sample((100,), seed=4)._data)
+    assert s.shape == (100, 3)
+    np.testing.assert_array_equal(s.sum(-1), 5.0)
+
+
+def test_kl_divergence_dispatch():
+    kl = _f(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)))
+    ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert abs(kl - ref) < 1e-6
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Beta(1.0, 1.0))

@@ -1,0 +1,121 @@
+"""Sequence-op tests over the (padded, lengths) TPU-native contract.
+
+reference: operators/sequence_ops/* defined over LoD tensors; semantics
+checked against hand-computed ragged results.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor.sequence import (sequence_concat,
+                                        sequence_enumerate,
+                                        sequence_expand_as, sequence_pad,
+                                        sequence_pool, sequence_reverse,
+                                        sequence_softmax, sequence_unpad)
+
+RAGGED = [np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32),
+          np.array([[7., 8.]], np.float32),
+          np.array([[9., 10.], [11., 12.]], np.float32)]
+
+
+def test_pad_unpad_round_trip():
+    padded, lens = sequence_pad(RAGGED, pad_value=-1.0)
+    p = np.asarray(padded._data)
+    assert p.shape == (3, 3, 2)
+    np.testing.assert_array_equal(np.asarray(lens._data), [3, 1, 2])
+    assert (p[1, 1:] == -1).all()
+    back = sequence_unpad(padded, lens)
+    for a, b in zip(RAGGED, back):
+        np.testing.assert_array_equal(a, np.asarray(b._data))
+
+
+def test_pool_modes_match_ragged():
+    padded, lens = sequence_pad(RAGGED)
+    for mode, ref_fn in [
+        ("sum", lambda a: a.sum(0)),
+        ("average", lambda a: a.mean(0)),
+        ("sqrt", lambda a: a.sum(0) / np.sqrt(a.shape[0])),
+        ("max", lambda a: a.max(0)),
+        ("first", lambda a: a[0]),
+        ("last", lambda a: a[-1]),
+    ]:
+        out = np.asarray(sequence_pool(padded, lens, mode)._data)
+        for i, a in enumerate(RAGGED):
+            np.testing.assert_allclose(out[i], ref_fn(a), rtol=1e-6,
+                                       err_msg=mode)
+
+
+def test_pool_empty_sequence_is_zero():
+    padded, lens = sequence_pad(RAGGED)
+    lens = paddle.to_tensor(np.array([3, 0, 2], np.int32))
+    for mode in ("sum", "average", "max", "first", "last"):
+        out = np.asarray(sequence_pool(padded, lens, mode)._data)
+        assert (out[1] == 0).all(), mode
+
+
+def test_reverse_keeps_padding_in_place():
+    padded, lens = sequence_pad(RAGGED, pad_value=-1.0)
+    out = np.asarray(sequence_reverse(padded, lens)._data)
+    np.testing.assert_array_equal(out[0], np.asarray(RAGGED[0])[::-1])
+    np.testing.assert_array_equal(out[2, :2], np.asarray(RAGGED[2])[::-1])
+    assert (out[1, 1:] == -1).all()         # padding untouched
+
+
+def test_softmax_masks_padding():
+    x = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+    lens = np.array([2, 3], np.int32)
+    out = np.asarray(sequence_softmax(x, lens)._data)
+    ref0 = np.exp(x[0, :2] - x[0, :2].max())
+    ref0 = ref0 / ref0.sum()
+    np.testing.assert_allclose(out[0, :2], ref0, rtol=1e-5)
+    assert out[0, 2] == 0.0
+    np.testing.assert_allclose(out[1].sum(), 1.0, rtol=1e-5)
+
+
+def test_expand_as_and_enumerate():
+    row = np.array([[1., 2.], [3., 4.]], np.float32)
+    lens = np.array([3, 1], np.int32)
+    out = np.asarray(sequence_expand_as(row, lens)._data)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_array_equal(out[0], np.tile(row[0], (3, 1)))
+    np.testing.assert_array_equal(out[1, 0], row[1])
+    assert (out[1, 1:] == 0).all()
+
+    ids = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], np.int32)
+    lens = np.array([3, 2], np.int32)
+    win = np.asarray(sequence_enumerate(ids, lens, win_size=2,
+                                        pad_value=-1)._data)
+    np.testing.assert_array_equal(win[0, 0], [1, 2])
+    np.testing.assert_array_equal(win[0, 2], [3, -1])   # overhang padded
+    np.testing.assert_array_equal(win[1, 1], [5, -1])
+    assert (win[0, 3] == -1).all()                      # past end
+
+
+def test_concat_repacks_lengths():
+    a, la = sequence_pad([np.array([[1.], [2.]], np.float32),
+                          np.array([[3.]], np.float32)])
+    b, lb = sequence_pad([np.array([[4.]], np.float32),
+                          np.array([[5.], [6.], [7.]], np.float32)])
+    out, lens = sequence_concat([(a, la), (b, lb)])
+    o = np.asarray(out._data)
+    np.testing.assert_array_equal(np.asarray(lens._data), [3, 4])
+    np.testing.assert_array_equal(o[0, :3, 0], [1, 2, 4])
+    np.testing.assert_array_equal(o[1, :4, 0], [3, 5, 6, 7])
+
+
+def test_sequence_ops_jit_compatible():
+    """The device-side ops (pool/reverse/softmax/enumerate) trace under
+    jit with static shapes."""
+    import jax
+    import jax.numpy as jnp
+    padded, lens = sequence_pad(RAGGED)
+
+    def f(p, ln):
+        from paddle_tpu.core.tensor import Tensor
+        s = sequence_pool(Tensor(p), Tensor(ln), "average")
+        r = sequence_reverse(Tensor(p), Tensor(ln))
+        return s._data, r._data
+
+    s, r = jax.jit(f)(padded._data, lens._data)
+    assert s.shape == (3, 2) and r.shape == (3, 3, 2)
