@@ -21,16 +21,25 @@ from ..core import dtypes
 from ..core.tensor import Tensor
 
 # Ops whose inputs are cast to low precision in O1 (MXU-bound ops).
+# `embedding` is here so the activation stream STARTS in bf16: with the
+# table gathered low-precision, every downstream residual add / dropout /
+# norm rides bf16 HBM traffic instead of f32 (the norms keep f32 internal
+# stats — see layer_norm in nn/functional.py).
 white_list = {
     "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
-    "scaled_dot_product_attention", "einsum",
+    "scaled_dot_product_attention", "einsum", "embedding",
 }
 
-# Ops kept in fp32 even under O2 (numerically sensitive).
+# Ops kept in fp32 even under O2 (numerically sensitive). `layer_norm` is
+# deliberately absent: it computes its statistics in f32 internally and
+# returns the input dtype, so casting its inputs up would only double the
+# activation bandwidth without improving accuracy. The buffer-carrying
+# norms (batch/group/instance) STAY listed: casting their running
+# mean/variance buffers low would degrade the EMA state they write back.
 black_list = {
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
-    "layer_norm", "batch_norm", "group_norm", "instance_norm", "norm",
+    "batch_norm", "group_norm", "instance_norm", "norm",
     "mean", "sum", "exp", "log", "logsumexp", "erf", "erfinv", "pow",
     "cumsum", "rsqrt", "sqrt", "square",
 }

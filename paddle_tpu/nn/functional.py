@@ -721,17 +721,22 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     n = len(tuple(normalized_shape))
 
     def _ln(a, *wb):
+        # full f32 internal compute, output in the input dtype: under a
+        # bf16 activation stream (AMP O1) the HBM traffic stays half-width
+        # while the statistics and the normalization keep f32 accuracy
+        # (this is why layer_norm is NOT on the AMP cast lists — the op
+        # manages its own precision)
         axes = tuple(range(a.ndim - n, a.ndim))
-        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
-        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
-        out = ((a - mean.astype(a.dtype)) *
-               jax.lax.rsqrt(var + epsilon).astype(a.dtype))
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
         if wb:
             w = wb[0]
-            out = out * w
+            out = out * w.astype(jnp.float32)
             if len(wb) > 1:
-                out = out + wb[1]
-        return out
+                out = out + wb[1].astype(jnp.float32)
+        return out.astype(a.dtype)
 
     args = [_t(x)]
     if weight is not None:
@@ -815,6 +820,9 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None, rng_name=None):
     if not training or p == 0.0:
         return _t(x)
+    if p >= 1.0:
+        x = _t(x)
+        return apply(lambda a: jnp.zeros_like(a), x, name="dropout")
     key = make_rng(rng_name)
 
     def _do(a):
@@ -823,7 +831,12 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         else:
             axes = (axis,) if isinstance(axis, int) else tuple(axis)
             shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        # integer threshold on raw 16-bit random words instead of
+        # bernoulli's uniform-float path: half the RNG bytes and no
+        # int->float convert chain, at a keep-probability granularity of
+        # 2^-16 (irrelevant next to bf16 activation noise)
+        bits = jax.random.bits(key, shape, dtype=jnp.uint16)
+        keep = bits >= jnp.uint16(min(round(p * 65536.0), 65535))
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)

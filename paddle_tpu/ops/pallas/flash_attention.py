@@ -5,25 +5,31 @@ replacement for the reference's fused CUDA attention stack
 (reference: paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h,
 fused_gate_attention_op.cu).
 
-Design (FlashAttention-2 schedule, expressed the Mosaic way):
+Design (FlashAttention-2 schedule, expressed the Mosaic way). Two kernel
+generations share the public entry:
 
-- forward: grid (B, H, num_q_blocks, num_k_blocks), the k dimension is the
-  innermost ("arbitrary") loop; running max `m`, normalizer `l` and the
-  unnormalized accumulator live in VMEM scratch that persists across the k
-  steps. At the last k step the output block and the logsumexp row are
-  written. Only O(block_q x block_k) score tiles ever materialize — HBM
-  traffic is O(S*D), not O(S^2).
-- backward: `delta = rowsum(dO * O)` precomputed in XLA, then two kernels:
-  dq (q outer, k inner) and dkv (k outer, q inner) that rematerialize the
-  probability tile from (q, k, lse) — no S^2 residuals are saved.
-- causal: score tiles strictly above the diagonal are skipped via
-  `pl.when` on the block indices (compute-skip; the grid stays rectangular).
-- bias: an optional additive bias broadcastable to [B, 1, 1, Sk]
-  (key-padding mask, the BERT case) is added to the score tile.
+v2 (default, bias-free): consumes q/k/v as [B, S, H*D] — a free bitcast of
+the framework layout, so NO transpose ever materializes around the kernel.
+A head is a static lane-column slice (two D=64 heads share one 128-lane
+block); each program processes `block_b` batch rows x the packed heads,
+amortizing per-program pipeline overhead. The backward is ONE fused kernel
+(grid q-sweep innermost): the score/dp tiles are computed once, dk/dv
+accumulate in block scratch written as each k block completes, and dq
+accumulates in a full-Sq f32 scratch flushed once through a
+constant-indexed full-sequence output window. delta = rowsum(dO*O) is
+computed in-kernel from blocks already in VMEM; lse rides a narrow
+[B, H, S, 8] tile.
 
-Inputs are [B, S, H, D] (the framework-wide attention layout); the kernel
-grid iterates (B, H) so arrays are viewed [B, H, S, D] internally. Compute
-is f32 on the MXU regardless of input dtype; outputs cast back.
+v1 (fallback: additive [B,1,1,Sk] bias, odd head counts): grid
+(B, H, nq, nk) over [B, H, S, D] views with the classic dq/dkv kernel
+split.
+
+Common to both: online-softmax forward with running (m, l) scratch,
+O(S*D) HBM traffic; causal tiles above the diagonal are compute-skipped
+via `pl.when`; in-kernel rematerialized dropout via a stateless
+murmur3-finalizer hash over absolute coordinates (the backward REGENERATES
+the mask, nothing is stored); MXU compute follows the framework matmul
+precision policy with f32 accumulation.
 
 Tests run these same kernels on CPU via the Pallas interpreter.
 """
@@ -193,9 +199,11 @@ def _mk_kernel(kern, has_bias, n_in=3, lse_out=True, has_seed=False, **kw):
     return wrapped
 
 
-def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
-         save_residuals=True, seed=None, rate=0.0):
-    """q,k,v: [B, H, S, D]. Returns (o, lse[B, H, S]) — lse is None when
+def _fwd_v1(q, k, v, bias, scale, causal, block_q, block_k,
+            save_residuals=True, seed=None, rate=0.0):
+    """q,k,v: [B, H, S, D]. Returns (o, lse[B, H, S, 8]) — the lse rows
+    stay in the narrow tile exactly as the kernel wrote them so the backward
+    can consume them without an XLA re-broadcast; lse is None when
     save_residuals=False (inference: no lse write, saves S*128 f32 HBM
     traffic per (b, h), mirroring the upstream kernel's save_residuals)."""
     B, H, Sq, D = q.shape
@@ -224,9 +232,11 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
                               lambda b, h, i, j: (b, h, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype)]
     if save_residuals:
-        out_specs.append(pl.BlockSpec((1, 1, block_q, 128),
+        # row stats ride a narrow 8-lane tile: [B, H, S, 8] is 16x less
+        # HBM than a full 128-lane broadcast and Mosaic accepts last-dim 8
+        out_specs.append(pl.BlockSpec((1, 1, block_q, 8),
                                       lambda b, h, i, j: (b, h, i, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct((B, H, Sq, 8), jnp.float32))
 
     out = pl.pallas_call(
         kern,
@@ -235,8 +245,8 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 8), jnp.float32),
+            pltpu.VMEM((block_q, 8), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -246,8 +256,309 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
     )(*args)
     if save_residuals:
         o, lse = out
-        return o, lse[:, :, :, 0]
+        return o, lse
     return out[0], None
+
+
+# ---------------------------------------------------------------------------
+# v2 kernels: native [B, S, H*D] layout, batched programs, fused backward
+#
+# The v1 kernels grid over every (batch, head) pair — for BERT-base shapes
+# that is 576 programs of ~2 µs work each, and the [B,S,H,D]->[B,H,S,D]
+# relayout XLA must materialize around them costs more HBM than the
+# attention itself. v2 instead:
+#   - consumes q/k/v as [B, S, E] (a free bitcast of the framework layout):
+#     a head is a static lane-column slice, two D=64 heads share one
+#     128-lane block, so no transpose ever materializes;
+#   - processes `block_b` batch rows x `hp` heads per program, amortizing
+#     the per-program pipeline overhead;
+#   - fuses the whole backward into ONE kernel producing dq/dk/dv in a
+#     single pass: the score and dp tiles are computed once (the v1 dq/dkv
+#     split computes them twice) with dk/dv accumulated across q-blocks in
+#     a full-S VMEM scratch.
+# Bias is not supported here (the padded-batch case routes to v1).
+# ---------------------------------------------------------------------------
+
+
+def _heads_per_block(D: int, H: int):
+    """Lane width of one kernel column block and the heads packed in it."""
+    if D % 128 == 0:
+        return 1, D
+    if D == 64 and H % 2 == 0:
+        return 2, 128
+    return None, None
+
+
+def _fwd2_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                 cd, off, rate, bb, hp, D):
+    bg, hg = pl.program_id(0), pl.program_id(1)
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = ((qi * block_q + block_q - 1 + off >= ki * block_k)
+           if causal else True)
+
+    @pl.when(run)
+    def _step():
+        for bi in range(bb):
+            for hh in range(hp):
+                q = q_ref[bi, :, hh * D:(hh + 1) * D]
+                k = k_ref[bi, :, hh * D:(hh + 1) * D]
+                v = v_ref[bi, :, hh * D:(hh + 1) * D]
+                s = _dot(q, k, ((1,), (1,)), cd) * scale
+                if causal:
+                    s = _causal_mask(s, qi, ki, block_q, block_k, off)
+                m_prev = m_scr[bi, hh][:, :1]
+                m_new = jnp.maximum(m_prev,
+                                    jnp.max(s, axis=1, keepdims=True))
+                shift = jnp.where(m_new == NEG_INF, 0.0, m_new)
+                p = jnp.exp(s - shift)
+                if causal:
+                    p = jnp.where(s == NEG_INF, 0.0, p)
+                alpha = jnp.exp(m_prev - shift)
+                l_new = alpha * l_scr[bi, hh][:, :1] \
+                    + jnp.sum(p, axis=1, keepdims=True)
+                pv = p
+                if rate > 0.0:
+                    b_abs = bg * bb + bi
+                    h_abs = hg * hp + hh
+                    pv = p * _dropout_keep(seed_ref, b_abs, h_abs, qi, ki,
+                                           p.shape, rate)
+                acc_scr[bi, hh] = acc_scr[bi, hh] * alpha \
+                    + _dot(pv, v, ((1,), (0,)), cd)
+                m_scr[bi, hh] = jnp.broadcast_to(m_new, m_scr[bi, hh].shape)
+                l_scr[bi, hh] = jnp.broadcast_to(l_new, l_scr[bi, hh].shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        for bi in range(bb):
+            outs = []
+            for hh in range(hp):
+                l = l_scr[bi, hh][:, :1]
+                safe_l = jnp.where(l == 0.0, 1.0, l)
+                outs.append(acc_scr[bi, hh] / safe_l)
+                if lse_ref is not None:
+                    m = m_scr[bi, hh][:, :1]
+                    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe_l))
+                    lse_ref[bi, hh] = jnp.broadcast_to(
+                        lse, lse_ref[bi, hh].shape)
+            o_ref[bi] = jnp.concatenate(outs, axis=1).astype(o_ref.dtype)
+
+
+def _fwd2(q, k, v, scale, causal, block_q, block_k, hp, width,
+          save_residuals=True, seed=None, rate=0.0, block_b=4):
+    """q,k,v: [B, S, E]. Returns (o [B,S,E], lse [B,H,Sq,8] or None)."""
+    B, Sq, E = q.shape
+    Sk = k.shape[1]
+    D = width // hp
+    H = E // D
+    nq, nk = Sq // block_q, Sk // block_k
+    while B % block_b:
+        block_b //= 2
+    bb = max(block_b, 1)
+
+    qs = pl.BlockSpec((bb, block_q, width), lambda b, h, i, j: (b, i, h))
+    ks = pl.BlockSpec((bb, block_k, width), lambda b, h, i, j: (b, j, h))
+    in_specs = []
+    args = []
+    if rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qs, ks, ks]
+    args += [q, k, v]
+
+    def kern(*refs):
+        if rate > 0.0:
+            seed_ref, refs = refs[0], refs[1:]
+        else:
+            seed_ref = None
+        if save_residuals:
+            q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s = refs
+        else:
+            q_r, k_r, v_r, o_r, m_s, l_s, a_s = refs
+            lse_r = None
+        return _fwd2_kernel(seed_ref, q_r, k_r, v_r, o_r, lse_r, m_s, l_s,
+                            a_s, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            cd=_mxu_dtype(q.dtype), off=Sk - Sq, rate=rate,
+                            bb=bb, hp=hp, D=D)
+
+    out_specs = [pl.BlockSpec((bb, block_q, width),
+                              lambda b, h, i, j: (b, i, h))]
+    out_shape = [jax.ShapeDtypeStruct((B, Sq, E), q.dtype)]
+    if save_residuals:
+        out_specs.append(pl.BlockSpec((bb, hp, block_q, 8),
+                                      lambda b, h, i, j: (b, h, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B, H, Sq, 8), jnp.float32))
+
+    out = pl.pallas_call(
+        kern,
+        grid=(B // bb, H // hp, nq, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bb, hp, block_q, 8), jnp.float32),
+            pltpu.VMEM((bb, hp, block_q, 8), jnp.float32),
+            pltpu.VMEM((bb, hp, block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    if save_residuals:
+        return out[0], out[1]
+    return out[0], None
+
+
+def _bwd2_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                 dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr, *,
+                 scale, causal, block_q, block_k, cd, off, rate, bb, hp, D):
+    """Fused backward: grid (B/bb, H/hp, nk, nq) with the q sweep innermost.
+
+    dk/dv accumulate across the inner q sweep in block-sized scratch and
+    are written at qi == nq-1 (their output block index is the OUTER ki,
+    stable across the sweep, so the window flushes exactly once). dq
+    accumulates across the whole (ki, qi) sweep in a full-Sq scratch; its
+    output window spans the full sequence with a constant index per
+    (b, h) program set and is written once at the final step."""
+    bg, hg = pl.program_id(0), pl.program_id(1)
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nk, nq = pl.num_programs(2), pl.num_programs(3)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = ((qi * block_q + block_q - 1 + off >= ki * block_k)
+           if causal else True)
+
+    @pl.when(run)
+    def _step():
+        for bi in range(bb):
+            for hh in range(hp):
+                sl = slice(hh * D, (hh + 1) * D)
+                q = q_ref[bi, :, sl]
+                k = k_ref[bi, :, sl]
+                v = v_ref[bi, :, sl]
+                do = do_ref[bi, :, sl]
+                o = o_ref[bi, :, sl]
+                lse = lse_ref[bi, hh][:, :1]
+                delta = jnp.sum(do.astype(jnp.float32)
+                                * o.astype(jnp.float32),
+                                axis=1, keepdims=True)
+                s = _dot(q, k, ((1,), (1,)), cd) * scale
+                if causal:
+                    s = _causal_mask(s, qi, ki, block_q, block_k, off)
+                p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
+                dp = _dot(do, v, ((1,), (1,)), cd)
+                pv = p
+                if rate > 0.0:
+                    b_abs = bg * bb + bi
+                    h_abs = hg * hp + hh
+                    keepf = _dropout_keep(seed_ref, b_abs, h_abs, qi, ki,
+                                          p.shape, rate)
+                    pv = p * keepf
+                    dp = dp * keepf
+                ds = p * (dp - delta) * scale
+                rows = pl.ds(qi * block_q, block_q)
+                dq_scr[bi, hh, rows] += _dot(ds, k, ((1,), (0,)), cd)
+                dk_scr[bi, hh] += _dot(ds, q, ((0,), (0,)), cd)
+                dv_scr[bi, hh] += _dot(pv, do, ((0,), (0,)), cd)
+
+    @pl.when(qi == nq - 1)
+    def _write_dkv():
+        for bi in range(bb):
+            dk_ref[bi] = jnp.concatenate(
+                [dk_scr[bi, hh] for hh in range(hp)],
+                axis=1).astype(dk_ref.dtype)
+            dv_ref[bi] = jnp.concatenate(
+                [dv_scr[bi, hh] for hh in range(hp)],
+                axis=1).astype(dv_ref.dtype)
+
+    @pl.when((ki == nk - 1) & (qi == nq - 1))
+    def _write_dq():
+        for bi in range(bb):
+            dq_ref[bi] = jnp.concatenate(
+                [dq_scr[bi, hh] for hh in range(hp)],
+                axis=1).astype(dq_ref.dtype)
+
+
+def _bwd2(q, k, v, o, lse, do, scale, causal, block_q, block_k, hp, width,
+          seed=None, rate=0.0, block_b=2):
+    """q,k,v,o,do: [B, S, E]; lse: [B, H, Sq, 8]. Returns dq, dk, dv."""
+    B, Sq, E = q.shape
+    Sk = k.shape[1]
+    D = width // hp
+    H = E // D
+    nq, nk = Sq // block_q, Sk // block_k
+    while B % block_b:
+        block_b //= 2
+    bb = max(block_b, 1)
+
+    qs = pl.BlockSpec((bb, block_q, width), lambda b, h, j, i: (b, i, h))
+    ks = pl.BlockSpec((bb, block_k, width), lambda b, h, j, i: (b, j, h))
+    rowq = pl.BlockSpec((bb, hp, block_q, 8),
+                        lambda b, h, j, i: (b, h, i, 0))
+    in_specs = []
+    args = []
+    if rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qs, ks, ks, qs, qs, rowq]
+    args += [q, k, v, do, o, lse]
+
+    def kern(*refs):
+        if rate > 0.0:
+            seed_ref, refs = refs[0], refs[1:]
+        else:
+            seed_ref = None
+        return _bwd2_kernel(seed_ref, *refs, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            cd=_mxu_dtype(q.dtype), off=Sk - Sq, rate=rate,
+                            bb=bb, hp=hp, D=D)
+
+    dq, dk, dv = pl.pallas_call(
+        kern,
+        grid=(B // bb, H // hp, nk, nq),
+        in_specs=in_specs,
+        out_specs=[
+            # dq: one full-sequence window per (b, h) program set — the
+            # index is constant over the (ki, qi) sweep so it flushes
+            # exactly once, after the final accumulation step
+            pl.BlockSpec((bb, Sq, width), lambda b, h, j, i: (b, 0, h)),
+            pl.BlockSpec((bb, block_k, width), lambda b, h, j, i: (b, j, h)),
+            pl.BlockSpec((bb, block_k, width), lambda b, h, j, i: (b, j, h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sq, E), q.dtype),
+            jax.ShapeDtypeStruct((B, Sk, E), k.dtype),
+            jax.ShapeDtypeStruct((B, Sk, E), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, hp, Sq, D), jnp.float32),
+            pltpu.VMEM((bb, hp, block_k, D), jnp.float32),
+            pltpu.VMEM((bb, hp, block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -255,8 +566,8 @@ def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-               dlt_ref, dq_ref, acc_scr, *, scale, causal, block_q,
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
+               lse_ref, dq_ref, acc_scr, *, scale, causal, block_q,
                block_k, cd, off, rate):
     b, h = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
@@ -272,7 +583,12 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     @pl.when(run)
     def _step():
         lse = lse_ref[0, 0][:, :1]                       # [bq, 1]
-        delta = dlt_ref[0, 0][:, :1]
+        # delta = rowsum(dO * O), recomputed from the blocks already in
+        # VMEM (D is small) — cheaper than an XLA precompute that writes
+        # and lane-broadcasts a [B, H, S, 128] array through HBM
+        delta = jnp.sum(do_ref[0, 0].astype(jnp.float32)
+                        * o_ref[0, 0].astype(jnp.float32),
+                        axis=1, keepdims=True)           # [bq, 1]
         s = _dot(q_ref[0, 0], k_ref[0, 0], ((1,), (1,)), cd) * scale
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
@@ -291,8 +607,8 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                dlt_ref, dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr, *,
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
+                lse_ref, dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr, *,
                 scale, causal, block_q, block_k, cd, off, rate):
     b, h = pl.program_id(0), pl.program_id(1)
     ki, qi = pl.program_id(2), pl.program_id(3)          # k outer, q inner
@@ -311,7 +627,9 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     @pl.when(run)
     def _step():
         lse = lse_ref[0, 0][:, :1]
-        delta = dlt_ref[0, 0][:, :1]
+        delta = jnp.sum(do_ref[0, 0].astype(jnp.float32)
+                        * o_ref[0, 0].astype(jnp.float32),
+                        axis=1, keepdims=True)           # [bq, 1]
         s = _dot(q_ref[0, 0], k_ref[0, 0], ((1,), (1,)), cd) * scale
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
@@ -350,27 +668,26 @@ def _mk_dkv_kernel(has_bias, has_seed=False, **kw):
             seed_ref = None
         if has_bias:
             return _dkv_kernel(seed_ref, *refs, **kw)
-        q, k, v, do, lse, dlt, dk, dv, dk_scr, dv_scr = refs
-        return _dkv_kernel(seed_ref, q, k, v, None, do, lse, dlt, dk, dv,
+        q, k, v, do, o, lse, dk, dv, dk_scr, dv_scr = refs
+        return _dkv_kernel(seed_ref, q, k, v, None, do, o, lse, dk, dv,
                            None, dk_scr, dv_scr, None, **kw)
 
     return wrapped
 
 
-def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
-              seed=None, rate=0.0):
+def _bwd_v1(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
+            seed=None, rate=0.0):
+    """lse arrives as the forward's [B, H, Sq, 8] narrow-tile output
+    and is fed straight to the kernels; delta = rowsum(dO*O) is computed
+    in-kernel from the dO/O blocks (no XLA precompute, no HBM round-trip
+    for either per-row vector)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     nq, nk = Sq // block_q, Sk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-
-    # per-row values (lse/delta) carried as [B, H, S, 128] lane-broadcasts
-    lse_t = jnp.broadcast_to(lse[..., None], (B, H, Sq, 128))
-    dlt_t = jnp.broadcast_to(delta[..., None], (B, H, Sq, 128))
 
     qs = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     ks_j = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
-    rowq = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i, j: (b, h, i, 0))
+    rowq = pl.BlockSpec((1, 1, block_q, 8), lambda b, h, i, j: (b, h, i, 0))
 
     seed_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
                   if rate > 0.0 else [])
@@ -381,8 +698,8 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
         dq_in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                         lambda b, h, i, j: (b, 0, 0, j)))
         dq_args.append(bias)
-    dq_in_specs += [qs, rowq, rowq]
-    dq_args += [do, lse_t, dlt_t]
+    dq_in_specs += [qs, qs, rowq]
+    dq_args += [do, o, lse]
 
     dq = pl.pallas_call(
         _mk_kernel(_dq_kernel, bias is not None, has_seed=rate > 0.0,
@@ -404,7 +721,7 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
     # dkv: grid (B, H, nk, nq) — i indexes k blocks, j indexes q blocks
     qs_j = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0))
     ks_i = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0))
-    rowq_j = pl.BlockSpec((1, 1, block_q, 128),
+    rowq_j = pl.BlockSpec((1, 1, block_q, 8),
                           lambda b, h, i, j: (b, h, j, 0))
     dkv_in_specs = seed_specs + [qs_j, ks_i, ks_i]
     dkv_args = seed_args + [q, k, v]
@@ -412,8 +729,8 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
         dkv_in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
                                          lambda b, h, i, j: (b, 0, 0, i)))
         dkv_args.append(bias)
-    dkv_in_specs += [qs_j, rowq_j, rowq_j]
-    dkv_args += [do, lse_t, dlt_t]
+    dkv_in_specs += [qs_j, qs_j, rowq_j]
+    dkv_args += [do, o, lse]
 
     dkv_out_specs = [
         pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -458,7 +775,7 @@ def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
 
 
 # ---------------------------------------------------------------------------
-# public entry (custom VJP over [B, H, S, D])
+# routing + public entry (custom VJP over [B, S, H, D])
 # ---------------------------------------------------------------------------
 
 
@@ -466,6 +783,82 @@ def _seed_arr(seed_f):
     """f32-bitcast seed words back to int32 (seed travels as a float arg so
     the custom_vjp can hand back a plain zero cotangent)."""
     return jax.lax.bitcast_convert_type(seed_f, jnp.int32)
+
+
+# VMEM budgets (bytes) for picking how many batch rows one v2 program
+# processes: the unrolled (bi, hh) loop keeps ~1 score tile live per
+# iteration in the forward and ~3 (s/dp/ds) in the backward, and the fused
+# backward additionally carries a full-Sq f32 dq scratch. The TPU scoped
+# vmem limit is 16M; stay well under it.
+_V2_FWD_TILE_BUDGET = 4 * 1024 * 1024
+_V2_BWD_TILE_BUDGET = 8 * 1024 * 1024
+_V2_SCRATCH_CAP = 4 * 1024 * 1024
+
+
+def _v2_plan(q, bias, block_q, block_k):
+    """(hp, width, bb_fwd, bb_bwd) when the v2 layout-native kernels
+    apply; None routes to v1."""
+    B, Sq, H, D = q.shape
+    if bias is not None:
+        return None
+    hp, width = _heads_per_block(D, H)
+    if hp is None:
+        return None
+    tile = block_q * block_k * 4
+
+    def pick(budget_tiles, scratch_per_b):
+        bb = 8
+        while bb > 1 and (B % bb or bb * hp * tile > budget_tiles
+                          or bb * scratch_per_b > _V2_SCRATCH_CAP):
+            bb //= 2
+        return bb
+
+    bb_fwd = pick(_V2_FWD_TILE_BUDGET, 0)
+    bb_bwd = pick(_V2_BWD_TILE_BUDGET // 3, hp * Sq * D * 4)
+    if hp * Sq * D * 4 > _V2_SCRATCH_CAP:
+        return None
+    return hp, width, bb_fwd, bb_bwd
+
+
+def _fwd(q, k, v, bias, scale, causal, block_q, block_k,
+         save_residuals=True, seed=None, rate=0.0):
+    """Route [B, S, H, D] inputs to the layout-native v2 kernels (no
+    transpose materializes) or the v1 [B, H, S, D] kernels (bias case)."""
+    plan = _v2_plan(q, bias, block_q, block_k)
+    if plan is not None:
+        hp, width, bb_fwd, _ = plan
+        B, Sq, H, D = q.shape
+        E = H * D
+        o, lse = _fwd2(q.reshape(B, Sq, E), k.reshape(B, k.shape[1], E),
+                       v.reshape(B, v.shape[1], E), scale, causal, block_q,
+                       block_k, hp, width, save_residuals=save_residuals,
+                       seed=seed, rate=rate, block_b=bb_fwd)
+        return o.reshape(q.shape), lse
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    o, lse = _fwd_v1(qt, kt, vt, bias, scale, causal, block_q, block_k,
+                     save_residuals=save_residuals, seed=seed, rate=rate)
+    return jnp.swapaxes(o, 1, 2), lse
+
+
+def _bwd_impl(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
+              seed=None, rate=0.0):
+    plan = _v2_plan(q, bias, block_q, block_k)
+    if plan is not None:
+        hp, width, _, bb_bwd = plan
+        B, Sq, H, D = q.shape
+        E = H * D
+        r3 = lambda x: x.reshape(B, x.shape[1], E)
+        dq, dk, dv = _bwd2(r3(q), r3(k), r3(v), r3(o), lse, r3(do), scale,
+                           causal, block_q, block_k, hp, width, seed=seed,
+                           rate=rate, block_b=bb_bwd)
+        return (dq.reshape(q.shape), dk.reshape(k.shape),
+                dv.reshape(v.shape), None)
+    qt, kt, vt, ot, dot_ = (jnp.swapaxes(x, 1, 2)
+                            for x in (q, k, v, o, do))
+    dq, dk, dv, db = _bwd_v1(qt, kt, vt, bias, ot, lse, dot_, scale, causal,
+                             block_q, block_k, seed=seed, rate=rate)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2), db)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
@@ -544,9 +937,5 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
             words.astype(jnp.uint32), jnp.float32)
     else:
         seed_f = jnp.zeros((2,), jnp.float32)
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    o = _flash(qt, kt, vt, bias, seed_f, float(scale), bool(causal),
-               int(block_q), int(block_k), rate)
-    return jnp.swapaxes(o, 1, 2)
+    return _flash(q, k, v, bias, seed_f, float(scale), bool(causal),
+                  int(block_q), int(block_k), rate)

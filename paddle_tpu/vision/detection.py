@@ -9,9 +9,11 @@ box_coder, multiclass_nms.
 
 TPU-native notes: prior/anchor generation is pure index math —
 vectorized meshgrid broadcasts, no per-pixel loops; box_coder is
-elementwise; multiclass_nms keeps static shapes ([N, keep_top_k, 6]
-plus valid counts) so it can sit at the end of a jitted detection head
-the way the reference's CUDA kernel sits at the end of the GPU graph.
+elementwise; multiclass_nms is HOST-SIDE post-processing (numpy over
+device outputs, like the reference's CPU-only multiclass_nms_op) with
+static output shapes ([N, keep_top_k, 6] plus valid counts) — call it
+on the readback side of a jitted detection head, not inside jit (the
+in-jit building block is ops.nms / vision.ops).
 """
 
 from __future__ import annotations

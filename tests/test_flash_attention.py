@@ -191,33 +191,34 @@ def test_dropout_matches_host_mask_reference(causal, block):
     Bv, Sv, Hv, Dv = 2, 256, 2, 64
     rate = 0.3
     rng = np.random.RandomState(12)
-    mk = lambda: jnp.swapaxes(jnp.asarray(  # noqa: E731
-        rng.randn(Bv, Sv, Hv, Dv).astype(np.float32)) * 0.3, 1, 2)
+    # _flash takes the framework [B, S, H, D] layout directly
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(Bv, Sv, Hv, Dv).astype(np.float32)) * 0.3
     q, k, v = mk(), mk(), mk()
     seed_f = jnp.zeros((2,), jnp.float32)
     keep = jnp.asarray(np.stack([np.stack(
         [_host_keep(Sv, b, h, rate) for h in range(Hv)])
         for b in range(Bv)]))
-    G = jnp.asarray(rng.randn(Bv, Hv, Sv, Dv).astype(np.float32))
+    G = jnp.asarray(rng.randn(Bv, Sv, Hv, Dv).astype(np.float32))
     cm = jnp.tril(jnp.ones((Sv, Sv), bool))
 
     def ref_loss(q_, k_, v_):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * 0.125
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_, k_) * 0.125
         if causal:
             s = jnp.where(cm[None, None], s, -1e30)
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p * keep, v_) * G)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p * keep, v_) * G)
 
     def kern_loss(q_, k_, v_):
         return jnp.sum(_flash(q_, k_, v_, None, seed_f, 0.125, causal,
                               block, block, rate) * G)
 
     o_k = _flash(q, k, v, None, seed_f, 0.125, causal, block, block, rate)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * 0.125
     if causal:
         s = jnp.where(cm[None, None], s, -1e30)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-    o_r = jnp.einsum("bhqk,bhkd->bhqd", p * keep, v)
+    o_r = jnp.einsum("bhqk,bkhd->bqhd", p * keep, v)
     np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r),
                                atol=2e-5, rtol=2e-5)
 

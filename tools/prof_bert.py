@@ -1,0 +1,104 @@
+"""Throwaway: attribute BERT step time by timing ablations on the chip."""
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def timed_step(step, args, iters=15):
+    loss = step(*args)
+    float(loss)
+    for _ in range(3):
+        loss = step(*args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(*args)
+    float(loss)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def build(hidden_do=0.1, attn_do=0.1, flash=True, fwd_only=False,
+          no_opt=False):
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    from paddle_tpu.optimizer import AdamW
+
+    import paddle_tpu.ops.attention as att
+    if not flash:
+        att._flash_supported = lambda *a, **k: False
+    else:
+        import importlib
+        importlib.reload(att)
+
+    B, S, M = 48, 512, 76
+    cfg = BertConfig(hidden_dropout_prob=hidden_do,
+                     attention_dropout_prob=attn_do)
+    paddle.seed(42)
+    model = BertForMaskedLM(cfg)
+
+    def loss_fn(layer, ids, pos, labels):
+        with paddle.amp.auto_cast(level="O1"):
+            scores = layer(ids, masked_positions=pos)
+            return layer.loss(scores, labels)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    pos = np.stack([rng.choice(S, M, replace=False) for _ in range(B)]
+                   ).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, M)).astype(np.int32)
+
+    if fwd_only:
+        import jax
+        from paddle_tpu.core.random import trace_rng
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.jit.functional import bind, buffer_arrays, \
+            param_arrays
+        params = param_arrays(model)
+        bufs = buffer_arrays(model)
+
+        @jax.jit
+        def fwd(p, i, po, la):
+            with trace_rng(jax.random.key(0)):
+                with bind(model, p, dict(bufs)):
+                    return loss_fn(model, Tensor(i), Tensor(po),
+                                   Tensor(la))._data
+
+        return (lambda i, po, la: fwd(params, i, po, la)), (ids, pos, labels)
+
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01)
+    step = TrainStep(model, loss_fn, opt)
+    return step, (ids, pos, labels)
+
+
+def main():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as paddle
+    paddle.set_flags({"tpu_matmul_precision": "default"})
+    which = sys.argv[1:] or ["base", "nodrop", "noattndrop", "noflash",
+                             "fwdonly", "fwdonly_nodrop"]
+    cfgs = {
+        "base": dict(),
+        "nodrop": dict(hidden_do=0.0, attn_do=0.0),
+        "noattndrop": dict(attn_do=0.0),
+        "nohiddendrop": dict(hidden_do=0.0),
+        "noflash": dict(flash=False),
+        "fwdonly": dict(fwd_only=True),
+        "fwdonly_nodrop": dict(fwd_only=True, hidden_do=0.0, attn_do=0.0),
+    }
+    for name in which:
+        step, args = build(**cfgs[name])
+        ms = timed_step(step, args)
+        tok = 48 * 512 / (ms / 1e3)
+        log(f"{name:16s} {ms:7.1f} ms/step  {tok:10,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()
