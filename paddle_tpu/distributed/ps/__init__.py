@@ -6,15 +6,20 @@ python/paddle/distributed/fleet in PS mode with
 role_maker/init_server/init_worker). Its job: embedding tables far larger
 than accelerator memory, updated sparsely.
 
-TPU-native redesign: on TPU pods the "server" is the host RAM attached to
-every worker (hundreds of GB) — so the PS collapses to an in-process
-host-memory SparseTable with pull (gather rows -> device) and push
-(apply sparse optimizer update host-side), sharded by `id % num_shards`
-across hosts in multi-host jobs (each host owns its shard; cross-host
-traffic uses the same pull/push API). DistributedEmbedding wires the
-pull into forward and the push into the backward tape, so training code
-sees an ordinary Layer while gradients stream back to host memory —
-the reference's async push/pull becomes the natural eager flow.
+TPU-native redesign, two tiers:
+ - in-process host-memory `SparseTable` (this file): on TPU pods the
+   first "server" is the host RAM attached to every worker (hundreds of
+   GB) — pull gathers rows to device, push applies the sparse optimizer
+   host-side;
+ - a REAL process model (`service.py` + `_native/ps_server.cpp`): C++
+   server processes hosting dense+sparse tables over TCP, a python
+   `PSClient` with client-side key sharding across servers, and an
+   `AsyncCommunicator` background sender — the reference's
+   brpc_ps_server/communicator pair rebuilt lean.
+DistributedEmbedding wires pull into forward and push into the backward
+tape over either backend, so training code sees an ordinary Layer while
+gradients stream to host/remote memory — the reference's async
+push/pull becomes the natural eager flow.
 """
 
 from __future__ import annotations
@@ -28,7 +33,13 @@ import numpy as np
 from ...core.tensor import TapeNode, Tensor, _wrap_outputs, is_grad_enabled
 from ...nn.layer import Layer
 
-__all__ = ["SparseTable", "DistributedEmbedding"]
+__all__ = ["SparseTable", "DistributedEmbedding", "PSClient",
+           "PSServerHandle", "AsyncCommunicator", "run_server",
+           "role_from_env", "server_endpoints_from_env"]
+
+from .service import (AsyncCommunicator, PSClient,  # noqa: E402
+                      PSServerHandle, role_from_env, run_server,
+                      server_endpoints_from_env)
 
 
 class SparseTable:
@@ -115,18 +126,51 @@ class DistributedEmbedding(Layer):
     """Embedding whose table lives in host memory (PS-style).
 
     forward: host pull -> device array; backward: the tape node pushes the
-    row gradients straight into the SparseTable (fused server update — the
+    row gradients straight into the table (fused server update — the
     reference's async push). The table is NOT a Parameter: dense
     optimizers skip it, exactly like the reference's PS-mode embeddings.
+
+    Two backends:
+      - in-process `SparseTable` (default): host RAM of this worker;
+      - a remote PS service via `client=PSClient(...)` + `table_id=`:
+        rows pulled over TCP from the C++ server processes
+        (ps.service / _native/ps_server.cpp); gradients pushed either
+        synchronously or through an `AsyncCommunicator` (reference's
+        async-SGD mode, communicator.cc).
     """
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  table: Optional[SparseTable] = None, lr: float = 0.05,
-                 optimizer: str = "adagrad", name=None):
+                 optimizer: str = "adagrad", name=None, client=None,
+                 table_id: int = 0, communicator=None):
         super().__init__()
-        self.table = table or SparseTable(num_embeddings, embedding_dim,
-                                          optimizer=optimizer, lr=lr)
+        self.client = client
+        self.table_id = table_id
+        self.communicator = communicator
+        if client is None:
+            self.table = table or SparseTable(num_embeddings, embedding_dim,
+                                              optimizer=optimizer, lr=lr)
+        else:
+            self.table = None
         self.embedding_dim = embedding_dim
+
+    def _pull(self, ids_np: np.ndarray) -> np.ndarray:
+        flat = ids_np.reshape(-1)
+        if self.client is not None:
+            return self.client.pull_sparse(self.table_id,
+                                           flat.astype(np.uint64),
+                                           self.embedding_dim)
+        return self.table.pull(flat)
+
+    def _push(self, ids_np: np.ndarray, grads: np.ndarray) -> None:
+        flat = ids_np.reshape(-1).astype(np.uint64)
+        g = grads.reshape(len(flat), self.embedding_dim)
+        if self.client is None:
+            self.table.push(ids_np.reshape(-1), g)
+        elif self.communicator is not None:
+            self.communicator.push_sparse_grad(self.table_id, flat, g)
+        else:
+            self.client.push_sparse(self.table_id, flat, g, grad=True)
 
     def forward(self, ids: Tensor) -> Tensor:
         from ...core.tensor import _is_tracer
@@ -138,14 +182,14 @@ class DistributedEmbedding(Layer):
                 "output as a batch input), like the reference's PS-mode "
                 "embeddings which live outside the trainer program")
         ids_np = np.asarray(raw)
-        rows = self.table.pull(ids_np)
+        rows = self._pull(ids_np)
         out = jnp.asarray(rows.reshape(ids_np.shape + (self.embedding_dim,)))
         node = None
         if is_grad_enabled():
-            table = self.table
+            push = self._push
 
             def vjp_fn(g, ids_np=ids_np):
-                table.push(ids_np, np.asarray(g))
+                push(ids_np, np.asarray(g))
                 return ()                  # no upstream tensors
 
             node = TapeNode(vjp_fn, [],

@@ -1,0 +1,135 @@
+"""Parameter-server process model: C++ server + python client + async
+communicator (reference: paddle/fluid/distributed/service/brpc_ps_server.h,
+brpc_ps_client.h, communicator.cc)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import service as svc
+
+pytestmark = pytest.mark.skipif(
+    not svc.native_available(), reason="no C++ toolchain for ps_server")
+
+
+@pytest.fixture()
+def cluster():
+    """Two PS processes + a connected client (real process model)."""
+    servers = [svc.PSServerHandle(), svc.PSServerHandle()]
+    client = svc.PSClient([s.endpoint for s in servers])
+    yield servers, client
+    client.stop_servers()
+    for s in servers:
+        assert s.wait(timeout=10) == 0    # clean shutdown on STOP
+
+
+def test_dense_pull_push_sgd(cluster):
+    _, client = cluster
+    client.ping()
+    client.create_table(0, kind="dense", dim=8, rows=4, optimizer="sgd",
+                        lr=0.5, seed=3)
+    w0 = client.pull_dense(0, 4, 8)
+    assert w0.shape == (4, 8) and np.abs(w0).max() <= 0.01
+    g = np.ones((4, 8), np.float32)
+    client.push_dense(0, g, grad=True)
+    w1 = client.pull_dense(0, 4, 8)
+    np.testing.assert_allclose(w1, w0 - 0.5 * g, atol=1e-6)
+    # set semantics
+    client.push_dense(0, np.full((4, 8), 7.0, np.float32), grad=False)
+    np.testing.assert_allclose(client.pull_dense(0, 4, 8), 7.0)
+
+
+def test_sparse_lazy_init_deterministic_and_sharded(cluster):
+    servers, client = cluster
+    client.create_table(1, kind="sparse", dim=16, optimizer="sgd", lr=1.0,
+                        seed=9, init_scale=0.05)
+    keys = np.arange(100, dtype=np.uint64)
+    rows = client.pull_sparse(1, keys, 16)
+    assert rows.shape == (100, 16) and np.abs(rows).max() <= 0.05
+    # deterministic: same keys -> identical rows, any order
+    again = client.pull_sparse(1, keys[::-1].copy(), 16)
+    np.testing.assert_array_equal(again, rows[::-1])
+    # rows really live on BOTH server processes (client-side sharding)
+    per_server = [client.num_rows(1)]
+    solo = svc.PSClient([servers[0].endpoint])
+    n0 = solo.num_rows(1)
+    solo.close()
+    assert per_server[0] == 100 and 0 < n0 < 100
+
+
+def test_sparse_grad_apply_and_duplicate_keys(cluster):
+    _, client = cluster
+    client.create_table(2, kind="sparse", dim=4, optimizer="sgd", lr=0.1,
+                        seed=1, init_scale=0.0)   # zero init: exact math
+    keys = np.array([5, 9], dtype=np.uint64)
+    w0 = client.pull_sparse(2, keys, 4)
+    np.testing.assert_allclose(w0, 0.0)
+    g = np.stack([np.full(4, 1.0), np.full(4, 2.0)]).astype(np.float32)
+    client.push_sparse(2, keys, g, grad=True)
+    w1 = client.pull_sparse(2, keys, 4)
+    np.testing.assert_allclose(w1, -0.1 * g, atol=1e-6)
+
+
+def test_save_load_roundtrip(cluster, tmp_path):
+    _, client = cluster
+    client.create_table(3, kind="sparse", dim=8, optimizer="adagrad",
+                        lr=0.1, seed=4)
+    keys = np.arange(50, dtype=np.uint64)
+    client.push_sparse(3, keys, np.ones((50, 8), np.float32), grad=True)
+    trained = client.pull_sparse(3, keys, 8)
+    client.save(3, str(tmp_path / "ckpt"))
+    # clobber, then restore
+    client.push_sparse(3, keys, np.zeros((50, 8), np.float32), grad=False)
+    client.load(3, str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(client.pull_sparse(3, keys, 8), trained)
+    files = os.listdir(tmp_path / "ckpt")
+    assert len(files) == 2                      # one shard file per server
+
+
+def test_async_communicator_merges_and_flushes(cluster):
+    _, client = cluster
+    client.create_table(4, kind="sparse", dim=4, optimizer="sgd", lr=1.0,
+                        seed=0, init_scale=0.0)
+    comm = svc.AsyncCommunicator(client, send_every=0.002)
+    # duplicate keys across pushes must SUM before the apply
+    for _ in range(10):
+        comm.push_sparse_grad(4, np.array([7], np.uint64),
+                              np.full((1, 4), 0.5, np.float32))
+    comm.flush()
+    comm.stop()
+    w = client.pull_sparse(4, np.array([7], np.uint64), 4)
+    np.testing.assert_allclose(w, -5.0, atol=1e-5)
+
+
+def test_distributed_embedding_over_service(cluster):
+    """End-to-end: DistributedEmbedding trains against the PS processes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.ps import DistributedEmbedding
+
+    _, client = cluster
+    client.create_table(5, kind="sparse", dim=8, optimizer="sgd", lr=1.0,
+                        seed=2, init_scale=0.01)
+    emb = DistributedEmbedding(1000, 8, client=client, table_id=5)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+    target = np.ones((2, 2, 8), np.float32)
+
+    losses = []
+    for _ in range(60):
+        out = emb(ids)
+        loss = ((out - paddle.to_tensor(target)) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+    # the trained rows live on the servers, not in the layer
+    rows = client.pull_sparse(5, np.array([1, 2, 3], np.uint64), 8)
+    assert np.abs(rows - 1.0).mean() < 0.3
+
+
+def test_role_env_protocol(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "127.0.0.1:1234,127.0.0.1:1235")
+    assert svc.role_from_env() == "PSERVER"
+    assert svc.server_endpoints_from_env() == ["127.0.0.1:1234",
+                                               "127.0.0.1:1235"]
