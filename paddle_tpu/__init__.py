@@ -86,6 +86,8 @@ from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
+from . import inference  # noqa: F401
+from . import slim  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -33,6 +33,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <memory>
@@ -60,6 +61,7 @@ enum Op : uint8_t {
   kLoad = 9,
   kStats = 10,
   kStop = 11,
+  kKind = 12,
 };
 
 enum OptKind : uint8_t { kSGD = 0, kAdagrad = 1, kAdam = 2 };
@@ -278,7 +280,8 @@ void handle_pull_sparse(SparseTable& t, const std::vector<char>& body,
   if (body.size() < 8) { respond_err(fd, "short request"); return; }
   const char* p = body.data();
   uint64_t n = rd<uint64_t>(p);
-  if (body.size() != 8 + n * 8) {
+  // bound BEFORE multiplying: wire-controlled n must not overflow
+  if (n > (body.size() - 8) / 8 || body.size() != 8 + n * 8) {
     respond_err(fd, "pull_sparse size mismatch");
     return;
   }
@@ -298,7 +301,8 @@ void handle_push_sparse(SparseTable& t, const std::vector<char>& body,
   if (body.size() < 8) { respond_err(fd, "short request"); return; }
   const char* p = body.data();
   uint64_t n = rd<uint64_t>(p);
-  if (body.size() != 8 + n * 8 + n * t.dim * sizeof(float)) {
+  if (n > (body.size() - 8) / 8 ||
+      body.size() != 8 + n * 8 + n * t.dim * sizeof(float)) {
     respond_err(fd, "push_sparse size mismatch");
     return;
   }
@@ -427,6 +431,10 @@ void serve_conn(Server& srv, int fd) {
     uint64_t nbytes;
     std::memcpy(&table, hdr + 1, 4);
     std::memcpy(&nbytes, hdr + 5, 8);
+    if (nbytes > (1ULL << 31)) {      // 2 GiB request cap
+      respond_err(fd, "request too large");
+      break;
+    }
     std::vector<char> body(nbytes);
     if (nbytes && !read_full(fd, body.data(), nbytes)) break;
 
@@ -499,6 +507,13 @@ void serve_conn(Server& srv, int fd) {
         respond(fd, 0, &n, 8);
         break;
       }
+      case kKind: {
+        uint8_t k = 2;                 // absent
+        if (srv.dense_at(table)) k = 0;
+        else if (srv.sparse_at(table)) k = 1;
+        respond(fd, 0, &k, 1);
+        break;
+      }
       case kStop:
         respond(fd, 0, nullptr, 0);
         srv.stop.store(true);
@@ -540,7 +555,9 @@ int main(int argc, char** argv) {
   std::printf("PS_SERVER_READY %d\n", ntohs(addr.sin_port));
   std::fflush(stdout);
 
-  Server srv;
+  // heap-allocated and never deleted: detached connection threads may
+  // still hold the reference at exit; _Exit below skips destructors
+  Server& srv = *new Server();
   srv.listen_fd = lfd;
   while (!srv.stop.load()) {
     int cfd = ::accept(lfd, nullptr, nullptr);
@@ -557,5 +574,5 @@ int main(int argc, char** argv) {
   ::close(lfd);
   for (int i = 0; i < 500 && srv.active_conns.load() > 0; ++i)
     std::this_thread::sleep_for(std::chrono::milliseconds(10));
-  return 0;
+  std::_Exit(0);   // immediate: no destructor races with lingering threads
 }

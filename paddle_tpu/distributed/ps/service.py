@@ -37,7 +37,7 @@ _SRC = os.path.join(os.path.dirname(__file__), "_native", "ps_server.cpp")
 # protocol op codes (keep in sync with ps_server.cpp)
 _PING, _CREATE, _PULL_DENSE, _PUSH_DENSE, _PUSH_DENSE_GRAD = 0, 1, 2, 3, 4
 _PULL_SPARSE, _PUSH_SPARSE_GRAD, _PUSH_SPARSE = 5, 6, 7
-_SAVE, _LOAD, _STATS, _STOP = 8, 9, 10, 11
+_SAVE, _LOAD, _STATS, _STOP, _KIND = 8, 9, 10, 11, 12
 
 _OPT_KINDS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
@@ -241,10 +241,23 @@ class PSClient:
             self._conns[s].request(op, table_id, payload)
 
     # -- checkpoint / stats ---------------------------------------------
+    def table_kind(self, table_id: int) -> str:
+        """'dense' | 'sparse' | 'absent' — queried from the servers when
+        this client did not create the table itself (e.g. a separate
+        checkpointing process)."""
+        kind = self._kinds.get(table_id)
+        if kind is None:
+            owner = self._conns[table_id % self.n]
+            k = owner.request(_KIND, table_id)[0]
+            kind = {0: "dense", 1: "sparse", 2: "absent"}[k]
+            if kind != "absent":
+                self._kinds[table_id] = kind
+        return kind
+
     def _table_conns(self, table_id: int):
         """(shard, conn) pairs owning this table: the single owner for a
         dense table, every server for a sparse one."""
-        if self._kinds.get(table_id, "sparse") == "dense":
+        if self.table_kind(table_id) == "dense":
             s = table_id % self.n
             return [(s, self._conns[s])]
         return list(enumerate(self._conns))
@@ -297,22 +310,19 @@ class AsyncCommunicator:
         self._idle.clear()
         self._q.put((table_id, np.asarray(keys), np.asarray(grads)))
 
-    def _drain_batch(self) -> Dict[int, Tuple[Dict[int, np.ndarray]]]:
-        merged: Dict[int, Dict[int, np.ndarray]] = {}
-        drained = False
+    def _drain_batch(self) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """Concatenate everything queued per table; the duplicate-key SUM
+        happens vectorized inside PSClient.push_sparse."""
+        pending: Dict[int, list] = {}
         while True:
             try:
                 table, keys, grads = self._q.get_nowait()
             except queue.Empty:
                 break
-            drained = True
-            acc = merged.setdefault(table, {})
-            for k, g in zip(keys.tolist(), grads):
-                if k in acc:
-                    acc[k] = acc[k] + g
-                else:
-                    acc[k] = np.array(g, np.float32, copy=True)
-        return merged if drained else {}
+            pending.setdefault(table, []).append((keys, grads))
+        return {t: (np.concatenate([k for k, _ in items]),
+                    np.concatenate([g for _, g in items]))
+                for t, items in pending.items()}
 
     def _run(self) -> None:
         try:
@@ -322,10 +332,10 @@ class AsyncCommunicator:
                     self._idle.set()
                     time.sleep(self._send_every)
                     continue
-                for table, acc in merged.items():
-                    keys = np.fromiter(acc.keys(), np.uint64, len(acc))
-                    grads = np.stack(list(acc.values()))
-                    self._client.push_sparse(table, keys, grads, grad=True)
+                for table, (keys, grads) in merged.items():
+                    self._client.push_sparse(
+                        table, keys.astype(np.uint64),
+                        grads.astype(np.float32), grad=True)
                 if self._q.empty():
                     self._idle.set()
         except BaseException as e:          # surfaced on next push/flush

@@ -1,0 +1,248 @@
+"""paddle.inference: Config + create_predictor deployment API.
+
+reference parity: the inference engine surface —
+`paddle.inference.Config` / `create_predictor` bound from
+pybind/inference_api.cc over AnalysisPredictor
+(reference: paddle/fluid/inference/api/analysis_predictor.cc:151
+Init, :411 Run; analysis passes in inference/analysis/), with the
+zero-copy handle API (get_input_handle / copy_from_cpu / run /
+get_output_handle / copy_to_cpu).
+
+TPU-native redesign: the reference's analysis/IR pass pipeline IS the
+XLA compiler here — a jit.save export is already a fused, laid-out TPU
+executable, so "optimization passes" reduce to choices made when the
+predictor is built:
+ - from a jit.save path: load the serialized executable and run it
+   (nothing to optimize — XLA did it at export);
+ - from a live Layer: apply the requested passes (bf16 weight cast,
+   int8 weight-only quantization via paddle_tpu.slim) and jit with
+   donated buffers; `save_optimized_model` re-exports the optimized
+   form for later zero-work loads.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """Predictor configuration (reference: inference_api.cc Config).
+
+    Construct from a jit.save path prefix (`Config("dir/model")` with
+    dir/model.jaxexport + .pdiparams on disk), or from a live layer via
+    `Config.from_layer(layer, input_spec=[...])`.
+    """
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # params_path kept for API parity; the jit.save bundle is
+        # addressed by one prefix
+        self.model_path = model_path
+        self.params_path = params_path
+        self.layer = None
+        self.input_spec = None
+        self._precision = PrecisionType.Float32
+        self._weight_quant = False
+        self._ir_optim = True
+        self._memory_optim = True
+
+    @classmethod
+    def from_layer(cls, layer, input_spec) -> "Config":
+        cfg = cls()
+        cfg.layer = layer
+        cfg.input_spec = list(input_spec)
+        return cfg
+
+    # -- optimization switches (reference Config surface) ----------------
+    def enable_tpu_bf16(self):
+        """Run matmul-class compute in bf16 (the analogue of
+        enable_mkldnn_bfloat16 / TRT fp16: the TPU MXU's fast path)."""
+        self._precision = PrecisionType.Bfloat16
+
+    def enable_int8(self):
+        """Weight-only int8 quantization (analogue of TRT int8; needs a
+        live layer — a serialized executable is already frozen)."""
+        self._weight_quant = True
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = bool(flag)
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = bool(flag)
+
+    # parity no-ops: XLA owns these decisions on TPU
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def summary(self) -> str:
+        src = self.model_path or f"layer:{type(self.layer).__name__}"
+        return (f"source: {src}\nprecision: {self._precision}\n"
+                f"weight_quant: {self._weight_quant}")
+
+
+class _Handle:
+    """Zero-copy style input/output handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str, shape=None):
+        self.name = name
+        self._shape = tuple(shape) if shape else None
+        self._value: Optional[np.ndarray] = None
+
+    def reshape(self, shape: Sequence[int]):
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError("run() has not produced this output yet")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return self._shape if self._value is None else self._value.shape
+
+
+class Predictor:
+    """Runs a frozen model: the AnalysisPredictor analogue."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        self._inputs: Dict[str, _Handle] = {}
+        self._outputs: Dict[str, _Handle] = {}
+        self._out_names: List[str] = []
+        if config.layer is not None:
+            self._init_from_layer(config)
+        elif config.model_path is not None:
+            self._init_from_export(config)
+        else:
+            raise ValueError("Config needs a model path or a layer")
+
+    # -- construction ----------------------------------------------------
+    def _init_from_export(self, config: Config):
+        from ..jit.to_static import load as jload
+        translated = jload(config.model_path)
+        if isinstance(translated, dict):
+            raise ValueError(
+                f"{config.model_path!r} is a weights-only save (no "
+                ".jaxexport executable); re-save with input_spec or use "
+                "Config.from_layer")
+        if config._weight_quant or \
+                config._precision != PrecisionType.Float32:
+            warnings.warn(
+                "a serialized executable is already compiled; precision/"
+                "quantization options apply only to Config.from_layer",
+                stacklevel=3)
+        self._runner = translated
+        spec = translated._meta.get("input_spec") or []
+        for i, (shape, dtype) in enumerate(spec):
+            self._inputs[f"x{i}"] = _Handle(f"x{i}", shape)
+
+    def _init_from_layer(self, config: Config):
+        from ..core.random import trace_rng
+        from ..core.tensor import Tensor, no_grad
+        from ..jit.functional import bind, buffer_arrays, param_arrays
+        from ..jit.input_spec import InputSpec
+
+        layer = config.layer
+        layer.eval()
+        if config._weight_quant:
+            from ..slim import quantize_weights
+            quantize_weights(layer)
+        params = param_arrays(layer)
+        buffers = buffer_arrays(layer)
+        if config._precision == PrecisionType.Bfloat16:
+            params = {k: v.astype(jnp.bfloat16)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v
+                      for k, v in params.items()}
+
+        specs = [s if isinstance(s, InputSpec) else InputSpec(s)
+                 for s in config.input_spec]
+
+        def pure(p, b, *inputs):
+            with bind(layer, p, dict(b)), no_grad(), \
+                    trace_rng(jax.random.key(0)):
+                out = layer(*[Tensor(i) for i in inputs])
+            from ..jit.functional import unwrap
+            return unwrap(out)
+
+        jitted = jax.jit(pure)
+        self._runner = lambda *raw: jitted(params, buffers, *raw)
+        for i, s in enumerate(specs):
+            self._inputs[f"x{i}"] = _Handle(f"x{i}", s.shape)
+
+    # -- reference API surface -------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> _Handle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._out_names)
+
+    def get_output_handle(self, name: str) -> _Handle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute. Either pass arrays directly (returns list of arrays,
+        the modern surface) or pre-fill input handles (zero-copy surface:
+        results land in the output handles)."""
+        if inputs is None:
+            vals = []
+            for name, h in self._inputs.items():
+                if h._value is None:
+                    raise RuntimeError(f"input {name!r} not set; call "
+                                       "get_input_handle(name)."
+                                       "copy_from_cpu(arr) first")
+                vals.append(h._value)
+        else:
+            vals = [np.asarray(v) for v in inputs]
+        raw = [jnp.asarray(v) for v in vals]
+        out = self._runner(*raw)
+        from ..core.tensor import Tensor
+        if isinstance(out, Tensor):
+            out = out._data
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        outs = [np.asarray(o._data if isinstance(o, Tensor) else o)
+                for o in outs]
+        self._out_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: _Handle(n) for n in self._out_names}
+        for n, o in zip(self._out_names, outs):
+            self._outputs[n]._value = o
+        return outs if inputs is not None else None
+
+    def save_optimized_model(self, path: str):
+        """Persist the (possibly quantized/bf16) layer as a jit.save
+        bundle so later loads skip the optimization work
+        (reference: the analysis pipeline's optimized-program cache)."""
+        if self._config.layer is None:
+            raise ValueError("already a serialized executable")
+        from ..jit.to_static import save as jsave
+        layer = self._config.layer
+        if self._config._precision == PrecisionType.Bfloat16:
+            # bake the SAME precision the live predictor runs (float
+            # params were only cast in the predictor's local copy)
+            layer.to(dtype="bfloat16")
+        jsave(layer, path, input_spec=self._config.input_spec)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

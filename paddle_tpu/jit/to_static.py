@@ -695,7 +695,11 @@ class TranslatedLayer:
     def state_dict(self):
         return {**self._params, **self._buffers}
 
-    def __call__(self, *inputs):
+    def __call__(self, *inputs, **feeds):
+        if feeds and not inputs:
+            # Executor.run feeds by name ('x0', 'x1', ...): order them
+            inputs = [feeds[k] for k in sorted(
+                feeds, key=lambda n: int(n.lstrip("x") or 0))]
         raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                for i in inputs]
         out = self._exported.call(self._params, self._buffers, *raw)

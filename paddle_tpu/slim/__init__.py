@@ -1,0 +1,239 @@
+"""Model compression: post-training quantization + QAT (paddle slim).
+
+reference parity: the slim stack — post-training quantization
+(reference: python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py: calibrate activation ranges, quantize
+weights channel-wise), QAT program rewrite
+(quantization_pass.py: fake_quantize_dequantize ops with moving-average
+ranges), and the int8 inference path (MKLDNN/TensorRT int8 kernels).
+
+TPU-native redesign: quantization is a LAYER-TREE rewrite, not a graph
+pass — `QuantizedLinear` replaces `nn.Linear` in place and XLA does the
+rest:
+ - weight-only int8 (`quantize_weights`): per-output-channel int8 weights
+   dequantized into the matmul's bf16 operand; XLA fuses the
+   dequant-multiply into the gemm prologue, halving/quartering weight HBM
+   traffic — the win that matters for memory-bound TPU decode.
+ - static int8 activations (`PostTrainingQuantization`): calibration runs
+   record per-layer absmax; `run()` bakes activation scales so the gemm
+   runs int8 x int8 -> int32 on the MXU's native int8 path.
+ - QAT (`QAT.quantize`): fake-quant straight-through estimators around
+   weights+activations; `convert` strips them back to a quantized deploy
+   model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+
+__all__ = ["QuantizedLinear", "quantize_weights",
+           "PostTrainingQuantization", "QAT", "fake_quant"]
+
+
+def _channel_scales(w: np.ndarray, bits: int = 8) -> np.ndarray:
+    """Per-output-channel symmetric scales for a [in, out] weight."""
+    absmax = np.abs(w).max(axis=0)
+    qmax = 2.0 ** (bits - 1) - 1
+    return np.maximum(absmax / qmax, 1e-8).astype(np.float32)
+
+
+class QuantizedLinear(Layer):
+    """Linear with int8 weights (+ optional static int8 activations).
+
+    Weight-only mode: y = x @ (q * scale) + b — the dequant multiply is
+    fused by XLA into the gemm's operand read (weights move through HBM
+    at 1/4 the f32 bytes).
+    Static-activation mode (act_scale set): both operands are quantized
+    and the gemm runs int8 x int8 -> int32 on the MXU, rescaled once.
+    """
+
+    def __init__(self, weight_q: np.ndarray, scale: np.ndarray, bias,
+                 act_scale: Optional[float] = None):
+        super().__init__()
+        self.register_buffer("weight_q", Tensor(jnp.asarray(weight_q,
+                                                            jnp.int8)))
+        self.register_buffer("scale", Tensor(jnp.asarray(scale,
+                                                         jnp.float32)))
+        self.bias = None
+        if bias is not None:
+            self.bias = self.create_parameter(tuple(np.asarray(
+                bias._data if isinstance(bias, Tensor) else bias).shape),
+                is_bias=True)
+            self.bias._data = jnp.asarray(
+                bias._data if isinstance(bias, Tensor) else bias)
+        self.act_scale = act_scale
+
+    @classmethod
+    def from_linear(cls, lin: Linear, act_scale: Optional[float] = None):
+        w = np.asarray(lin.weight._data, np.float32)
+        scale = _channel_scales(w)
+        q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+        return cls(q, scale, lin.bias, act_scale=act_scale)
+
+    def forward(self, x):
+        act_scale = self.act_scale
+
+        def _wo(a, q, s, *b):
+            w = q.astype(a.dtype) * s.astype(a.dtype)
+            y = jnp.matmul(a, w)
+            return y + b[0] if b else y
+
+        def _int8(a, q, s, *b):
+            aq = jnp.clip(jnp.round(a.astype(jnp.float32) / act_scale),
+                          -127, 127).astype(jnp.int8)
+            y = jax.lax.dot_general(
+                aq, q, (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = y.astype(jnp.float32) * (act_scale * s)
+            y = y.astype(a.dtype)
+            return y + b[0] if b else y
+
+        fn = _wo if act_scale is None else _int8
+        args = [x, self.weight_q, self.scale] + (
+            [self.bias] if self.bias is not None else [])
+        return apply(fn, *args, name="quantized_linear")
+
+    def extra_repr(self):
+        mode = "int8-act" if self.act_scale is not None else "weight-only"
+        return f"in={self.weight_q.shape[0]}, out={self.weight_q.shape[1]}" \
+               f", {mode}"
+
+
+def _replace_linears(model: Layer, make, min_params: int) -> int:
+    """Swap eligible Linear sublayers via `make(linear, qual_name)`."""
+    count = 0
+    for name, sub in list(model.named_sublayers(include_self=True)):
+        for child_name, child in list(sub._sub_layers.items()):
+            if type(child) is Linear:
+                w = child.weight
+                if int(np.prod(w.shape)) < min_params:
+                    continue
+                replacement = make(child, f"{name}.{child_name}".strip("."))
+                if replacement is not None:
+                    sub._sub_layers[child_name] = replacement
+                    count += 1
+    return count
+
+
+def quantize_weights(model: Layer, min_params: int = 4096) -> int:
+    """Weight-only int8 PTQ in place; returns #layers quantized.
+
+    reference: slim WeightQuantization (weight_quantize_type
+    'channel_wise_abs_max')."""
+    return _replace_linears(
+        model, lambda lin, _: QuantizedLinear.from_linear(lin), min_params)
+
+
+class PostTrainingQuantization:
+    """Static (activation) PTQ with absmax calibration.
+
+    reference: slim post_training_quantization.py — feed calibration
+    batches, record per-input absmax per quantized layer, then emit the
+    quantized model. Usage:
+
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_loader: ptq.collect(batch)   # forward passes
+        qmodel = ptq.run()
+    """
+
+    def __init__(self, model: Layer, min_params: int = 4096):
+        self.model = model
+        self.min_params = min_params
+        self._ranges: Dict[int, float] = {}
+        self._hooks = []
+        for _, sub in model.named_sublayers(include_self=True):
+            if type(sub) is Linear and \
+                    int(np.prod(sub.weight.shape)) >= min_params:
+                self._hooks.append(
+                    sub.register_forward_pre_hook(self._observe(id(sub))))
+
+    def _observe(self, key):
+        def hook(layer, inputs):
+            x = inputs[0]
+            m = float(jnp.abs(x._data if isinstance(x, Tensor) else x)
+                      .max())
+            self._ranges[key] = max(self._ranges.get(key, 0.0), m)
+            return None
+        return hook
+
+    def collect(self, *batch):
+        from ..core.tensor import no_grad
+        with no_grad():
+            self.model(*[b if isinstance(b, Tensor) else Tensor(b)
+                         for b in batch])
+
+    def run(self) -> Layer:
+        for h in self._hooks:
+            h.remove()
+
+        def make(lin, _):
+            m = self._ranges.get(id(lin))
+            if m is None or m == 0.0:
+                return None                      # never observed: keep f32
+            return QuantizedLinear.from_linear(lin, act_scale=m / 127.0)
+
+        _replace_linears(self.model, make, self.min_params)
+        return self.model
+
+
+def fake_quant(x, bits: int = 8, name=None):
+    """Quantize-dequantize with a straight-through gradient (QAT
+    building block; reference: fake_quantize_dequantize_moving_average op).
+    """
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def _fq(a):
+        s = jnp.maximum(jnp.max(jnp.abs(a)) / qmax, 1e-8)
+        q = jnp.clip(jnp.round(a / s), -qmax, qmax) * s
+        # straight-through: forward the quantized value, backprop identity
+        return a + jax.lax.stop_gradient(q - a)
+
+    return apply(_fq, x if isinstance(x, Tensor) else Tensor(x),
+                 name=name or "fake_quant")
+
+
+class _QATLinear(Layer):
+    """Linear trained under fake-quantized weights + activations."""
+
+    def __init__(self, lin: Linear, bits: int = 8):
+        super().__init__()
+        self.inner = lin
+        self.bits = bits
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = fake_quant(x, self.bits, name="fake_quant_act")
+        wq = fake_quant(self.inner.weight, self.bits, name="fake_quant_w")
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QAT:
+    """Quantization-aware training (reference: slim QuantizationTransformPass
+    / paddle.quantization QAT): `quantize` wraps layers with fake-quant,
+    `convert` emits the deployable int8 model."""
+
+    def __init__(self, bits: int = 8, min_params: int = 4096):
+        self.bits = bits
+        self.min_params = min_params
+
+    def quantize(self, model: Layer) -> Layer:
+        _replace_linears(model, lambda lin, _: _QATLinear(lin, self.bits),
+                         self.min_params)
+        return model
+
+    def convert(self, model: Layer) -> Layer:
+        """Strip fake-quant wrappers -> QuantizedLinear deploy form."""
+        for _, sub in list(model.named_sublayers(include_self=True)):
+            for child_name, child in list(sub._sub_layers.items()):
+                if isinstance(child, _QATLinear):
+                    sub._sub_layers[child_name] = \
+                        QuantizedLinear.from_linear(child.inner)
+        return model

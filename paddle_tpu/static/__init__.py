@@ -151,3 +151,64 @@ class Executor:
 
 # static-style layer helpers + functional control flow live in static.nn
 # (imported at module top)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Persist a deployable model (reference: fluid/io.py:1246 /
+    paddle.static.save_inference_model).
+
+    TPU-native: the deploy artifact is the jit.save bundle (StableHLO +
+    serialized executable + params). `fetch_vars` is the model — either a
+    Layer or a callable over the feed tensors; `feed_vars` are InputSpecs
+    (or Tensors whose shape/dtype define the signature)."""
+    from ..jit.input_spec import InputSpec
+    from ..jit.to_static import save as jsave
+    from ..nn.layer import Layer
+
+    specs = []
+    for v in feed_vars:
+        if isinstance(v, InputSpec):
+            specs.append(v)
+        else:
+            arr = v._data if isinstance(v, Tensor) else np.asarray(v)
+            specs.append(InputSpec(tuple(arr.shape), str(arr.dtype)))
+
+    model = fetch_vars
+    if isinstance(model, (list, tuple)):
+        if len(model) != 1:
+            raise ValueError("pass ONE Layer/callable as fetch_vars; "
+                             "multi-output models return tuples")
+        model = model[0]
+    if not isinstance(model, Layer):
+        if not callable(model):
+            raise TypeError(
+                "fetch_vars must be a Layer or a callable over the feed "
+                "tensors (legacy Variable graphs do not exist here)")
+        fn = model
+
+        class _FnLayer(Layer):
+            def forward(self, *xs):
+                return fn(*xs)
+
+        model = _FnLayer()
+    jsave(model, path_prefix, input_spec=specs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load a deployable model (reference: fluid/io.py:1466).
+
+    Returns (program, feed_names, fetch_names) for surface parity, where
+    `program` is a runnable TranslatedLayer: call
+    `program(*inputs)` or `executor.run(program, feed=...)`."""
+    from ..jit.to_static import load as jload
+
+    translated = jload(path_prefix)
+    if isinstance(translated, dict):
+        raise ValueError(
+            f"{path_prefix!r} holds weights only (saved without "
+            "input_spec); load with paddle.jit.load for the params dict")
+    spec = translated._meta.get("input_spec") or []
+    feed_names = [f"x{i}" for i in range(len(spec))]
+    return translated, feed_names, ["out0"]

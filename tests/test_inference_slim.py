@@ -1,0 +1,154 @@
+"""Inference engine (Config/create_predictor) + slim quantization
+(reference: inference/api/analysis_predictor.cc, contrib/slim)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, slim
+from paddle_tpu.jit.input_spec import InputSpec
+from paddle_tpu.nn import Linear
+
+
+class MLP(paddle.nn.Layer):
+    def __init__(self, din=64, dh=128, dout=10):
+        super().__init__()
+        self.fc1 = Linear(din, dh)
+        self.fc2 = Linear(dh, dout)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _x(b=4, d=64, seed=0):
+    return np.random.default_rng(seed).normal(size=(b, d)).astype(np.float32)
+
+
+def test_predictor_from_saved_export(tmp_path):
+    paddle.seed(0)
+    model = MLP()
+    ref = model(paddle.to_tensor(_x())).numpy()
+    from paddle_tpu.jit.to_static import save as jsave
+    jsave(model, str(tmp_path / "m"), input_spec=[InputSpec((4, 64),
+                                                            "float32")])
+    cfg = inference.Config(str(tmp_path / "m"))
+    pred = inference.create_predictor(cfg)
+    # zero-copy handle surface
+    assert pred.get_input_names() == ["x0"]
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(_x())
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    # direct surface
+    outs = pred.run([_x()])
+    np.testing.assert_allclose(outs[0], ref, atol=1e-5, rtol=1e-5)
+
+
+def test_predictor_from_layer_bf16_and_int8(tmp_path):
+    paddle.seed(1)
+    model = MLP()
+    x = _x(seed=3)
+    ref = model(paddle.to_tensor(x)).numpy()
+
+    cfg = inference.Config.from_layer(model, [InputSpec((4, 64), "float32")])
+    cfg.enable_tpu_bf16()
+    cfg.enable_int8()
+    pred = inference.create_predictor(cfg)
+    out = pred.run([x])[0]
+    # quantized+bf16: close but not bitwise
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.1, rel
+    # the layer really got quantized in place
+    assert type(model.fc1).__name__ == "QuantizedLinear"
+    # optimized re-export loads as a plain predictor
+    pred.save_optimized_model(str(tmp_path / "opt"))
+    pred2 = inference.create_predictor(inference.Config(str(tmp_path /
+                                                            "opt")))
+    out2 = pred2.run([x])[0]
+    np.testing.assert_allclose(out2, out, atol=2e-2, rtol=2e-2)
+
+
+def test_weight_only_quant_accuracy():
+    paddle.seed(2)
+    model = MLP(128, 256, 16)
+    x = _x(8, 128, seed=5)
+    ref = model(paddle.to_tensor(x)).numpy()
+    n = slim.quantize_weights(model, min_params=1)
+    assert n == 2
+    out = model(paddle.to_tensor(x)).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.05, rel     # int8 per-channel: ~1% typical
+    # int8 buffers actually stored
+    assert str(model.fc1.weight_q.dtype) == "int8"
+
+
+def test_static_ptq_runs_int8_matmul():
+    paddle.seed(3)
+    model = MLP(64, 128, 10)
+    x = _x(16, 64, seed=7)
+    ref = model(paddle.to_tensor(x)).numpy()
+    ptq = slim.PostTrainingQuantization(model, min_params=1)
+    for s in range(4):
+        ptq.collect(paddle.to_tensor(_x(16, 64, seed=s)))
+    q = ptq.run()
+    assert q.fc1.act_scale is not None and q.fc1.act_scale > 0
+    out = q(paddle.to_tensor(x)).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.15, rel     # full int8 act x weight path
+
+
+def test_qat_trains_and_converts():
+    paddle.seed(4)
+    model = MLP(32, 64, 4)
+    qat = slim.QAT(min_params=1)
+    qat.quantize(model)
+    assert type(model.fc1).__name__ == "_QATLinear"
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    x = paddle.to_tensor(_x(16, 32, seed=9))
+    y = paddle.to_tensor(np.zeros((16,), np.int64))
+    from paddle_tpu.nn import functional as F
+    losses = []
+    for _ in range(20):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::5]
+    ref = model(x).numpy()
+    qat.convert(model)
+    assert type(model.fc1).__name__ == "QuantizedLinear"
+    out = model(x).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.1, rel      # QAT-trained weights survive real quant
+
+
+def test_static_save_load_inference_model(tmp_path):
+    paddle.seed(5)
+    model = MLP()
+    x = _x(seed=11)
+    ref = model(paddle.to_tensor(x)).numpy()
+    path = paddle.static.save_inference_model(
+        str(tmp_path / "infer"), [InputSpec((4, 64), "float32")], model)
+    prog, feeds, fetches = paddle.static.load_inference_model(path)
+    assert feeds == ["x0"] and fetches == ["out0"]
+    out = prog(x)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_executor_runs_loaded_inference_model(tmp_path):
+    """The documented Executor.run(program, feed=...) path (keyword feeds
+    into a TranslatedLayer)."""
+    paddle.seed(6)
+    model = MLP()
+    x = _x(seed=13)
+    ref = model(paddle.to_tensor(x)).numpy()
+    path = paddle.static.save_inference_model(
+        str(tmp_path / "exe"), [InputSpec((4, 64), "float32")], model)
+    prog, feeds, _ = paddle.static.load_inference_model(path)
+    exe = paddle.static.Executor()
+    outs = exe.run(prog, feed={feeds[0]: x})
+    np.testing.assert_allclose(outs[0], ref, atol=1e-5, rtol=1e-5)

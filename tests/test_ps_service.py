@@ -133,3 +133,17 @@ def test_role_env_protocol(monkeypatch):
     assert svc.role_from_env() == "PSERVER"
     assert svc.server_endpoints_from_env() == ["127.0.0.1:1234",
                                                "127.0.0.1:1235"]
+
+
+def test_fresh_client_discovers_table_kind(cluster, tmp_path):
+    """A second client process (no local kind registry) can checkpoint a
+    dense table: the kind is discovered from the servers."""
+    servers, client = cluster
+    client.create_table(6, kind="dense", dim=4, rows=2, optimizer="sgd",
+                        lr=0.1, seed=0)
+    fresh = svc.PSClient([s.endpoint for s in servers])
+    assert fresh.table_kind(6) == "dense"
+    fresh.save(6, str(tmp_path / "dense_ckpt"))
+    assert len(os.listdir(tmp_path / "dense_ckpt")) == 1   # owner only
+    assert fresh.table_kind(99) == "absent"
+    fresh.close()
