@@ -25,7 +25,9 @@ if "JAX_DEFAULT_PRNG_IMPL" not in _os.environ:
 
 from .core import (  # noqa: F401
     CPUPlace,
+    CUDAPinnedPlace,
     CUDAPlace,
+    NPUPlace,
     Parameter,
     Place,
     TPUPlace,
@@ -46,6 +48,7 @@ from .core import (  # noqa: F401
 from .core.dtype import (  # noqa: F401
     bfloat16,
     bool_,
+    bool_ as bool,  # noqa: A004  (paddle.bool, reference dtype export)
     complex64,
     complex128,
     float16,
@@ -59,7 +62,14 @@ from .core.dtype import (  # noqa: F401
     set_default_dtype,
     uint8,
 )
+import numpy as _np
+# paddle.dtype: the dtype TYPE (reference exports the VarType class; jax
+# dtypes are numpy dtypes here)
+dtype = _np.dtype
 from .core.random import get_rng_state, set_rng_state  # noqa: F401
+# CUDA-named RNG state shims map to the device-generic generator state
+from .core.random import get_rng_state as get_cuda_rng_state  # noqa: F401
+from .core.random import set_rng_state as set_cuda_rng_state  # noqa: F401
 
 from .tensor import *  # noqa: F401,F403
 from . import tensor  # noqa: F401
@@ -91,6 +101,7 @@ from . import slim  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .nn.initializer import ParamAttr  # noqa: F401
 from .hapi import summary  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
 from .autograd.functional import grad  # noqa: F401

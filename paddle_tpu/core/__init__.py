@@ -1,7 +1,9 @@
 from . import dtype as dtypes
 from .device import (
     CPUPlace,
+    CUDAPinnedPlace,
     CUDAPlace,
+    NPUPlace,
     Place,
     TPUPlace,
     device_count,

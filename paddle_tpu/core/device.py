@@ -56,8 +56,9 @@ class TPUPlace(Place):
 
 # Aliases for reference-API parity (CUDAPlace users map to the accelerator).
 CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace     # pinned host memory: plain host arrays here
 XPUPlace = TPUPlace
-NPUPlace = TPUPlace
+NPUPlace = TPUPlace            # other-accelerator users land on the TPU
 
 
 @functools.lru_cache(maxsize=None)

@@ -696,10 +696,19 @@ class TranslatedLayer:
         return {**self._params, **self._buffers}
 
     def __call__(self, *inputs, **feeds):
-        if feeds and not inputs:
-            # Executor.run feeds by name ('x0', 'x1', ...): order them
-            inputs = [feeds[k] for k in sorted(
-                feeds, key=lambda n: int(n.lstrip("x") or 0))]
+        if feeds and inputs:
+            raise TypeError("pass inputs positionally OR as named feeds, "
+                            "not both")
+        if feeds:
+            # Executor.run feeds by name: exports name inputs 'x0','x1',...
+            def idx(n):
+                if not (n.startswith("x") and n[1:].isdigit()):
+                    raise KeyError(
+                        f"unknown feed {n!r}: a jit.save export names its "
+                        f"inputs positionally as "
+                        f"{['x%d' % i for i in range(len(feeds))]}")
+                return int(n[1:])
+            inputs = [feeds[k] for k in sorted(feeds, key=idx)]
         raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                for i in inputs]
         out = self._exported.call(self._params, self._buffers, *raw)

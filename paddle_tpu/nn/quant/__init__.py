@@ -1,0 +1,259 @@
+"""nn.quant: fake-quantization layers for QAT graphs.
+
+reference parity: python/paddle/nn/quant/quant_layers.py —
+FakeQuantAbsMax(:60), FakeQuantMovingAverageAbsMax(:119),
+FakeQuantChannelWiseAbsMax(:204), MovingAverageAbsMaxScale(:281),
+QuantizedConv2D(:344), QuantizedLinear(:511), MAOutputScaleLayer,
+FloatFunctionalLayer (functional_layers.py).
+
+TPU-native: every fake-quant is a quantize-dequantize with a
+straight-through gradient (stop_gradient residual), so the whole QAT
+graph stays jit-compilable; moving-average ranges live in buffers
+updated on the eager tape (and frozen under jit, matching the
+reference's is_test behavior). The deploy conversion lives in
+paddle_tpu.slim (QuantizedLinear with real int8 storage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ..layer import Layer
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",
+    "FakeQuantMovingAverageAbsMax", "MovingAverageAbsMaxScale",
+    "QuantizedLinear", "QuantizedConv2D", "QuantizedConv2DTranspose",
+    "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+    "FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
+]
+
+
+def _qdq(a, scale, qmax):
+    q = jnp.clip(jnp.round(a / scale), -qmax, qmax) * scale
+    return a + jax.lax.stop_gradient(q - a)     # straight-through grad
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor absmax fake quant (reference: quant_layers.py:60)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.quant_bits - 1) - 1
+
+        def _fq(a):
+            s = jnp.maximum(jnp.max(jnp.abs(a)) / qmax, 1e-9)
+            return _qdq(a, s, qmax)
+
+        return apply(_fq, x, name="fake_quantize_abs_max")
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel absmax fake quant (reference: quant_layers.py:204)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32"):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.quant_bits - 1) - 1
+        axis = self.quant_axis
+
+        def _fq(a):
+            red = tuple(i for i in range(a.ndim) if i != axis)
+            s = jnp.maximum(jnp.max(jnp.abs(a), axis=red, keepdims=True)
+                            / qmax, 1e-9)
+            return _qdq(a, s, qmax)
+
+        return apply(_fq, x, name="fake_channel_wise_quantize_abs_max")
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Moving-average absmax fake quant (reference: quant_layers.py:119):
+    the activation range is an EMA buffer updated in training mode."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)),
+                             persistable=True)
+        self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)),
+                             persistable=True)
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.quant_bits - 1) - 1
+        rate = self.moving_rate
+        if self.training:
+            def _update(a, sc, st):
+                absmax = jnp.max(jnp.abs(a))
+                st2 = st * rate + 1.0
+                sc2 = (sc * rate * st + absmax) / st2
+                return sc2, st2
+
+            sc2, st2 = apply(_update, x, self.scale, self.state,
+                             name="moving_average_abs_max_update")
+            self.scale._data = jax.lax.stop_gradient(sc2._data)
+            self.state._data = jax.lax.stop_gradient(st2._data)
+
+        def _fq(a, sc):
+            s = jnp.maximum(sc / qmax, 1e-9)
+            return _qdq(a, s, qmax)
+
+        return apply(_fq, x, self.scale,
+                     name="fake_quantize_moving_average_abs_max")
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observe (EMA absmax) without quantizing (reference:
+    quant_layers.py:281) — used to record output scales for deploy."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._fq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate)
+
+    @property
+    def scale(self):
+        return self._fq.scale
+
+    def forward(self, x):
+        if self.training:
+            fq = self._fq
+            rate = fq.moving_rate
+
+            def _update(a, sc, st):
+                absmax = jnp.max(jnp.abs(a))
+                st2 = st * rate + 1.0
+                sc2 = (sc * rate * st + absmax) / st2
+                return sc2, st2
+
+            sc2, st2 = apply(_update, x, fq.scale, fq.state,
+                             name="moving_average_abs_max_update")
+            fq.scale._data = jax.lax.stop_gradient(sc2._data)
+            fq.state._data = jax.lax.stop_gradient(st2._data)
+        return x
+
+
+class QuantizedLinear(Layer):
+    """QAT wrapper over nn.Linear (reference: quant_layers.py:511)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max", **kw):
+        super().__init__()
+        self.inner = layer
+        if weight_quantize_type == "channel_wise_abs_max":
+            self._fq_w = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                    quant_axis=1)
+        else:
+            self._fq_w = FakeQuantAbsMax(quant_bits=weight_bits)
+        self._fq_a = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                  quant_bits=activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.linear(self._fq_a(x), self._fq_w(self.inner.weight),
+                        self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    """QAT wrapper over nn.Conv2D (reference: quant_layers.py:344)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__()
+        self.inner = layer
+        self._fq_w = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                quant_axis=0)
+        self._fq_a = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                  quant_bits=activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+        inner = self.inner
+        return F.conv2d(self._fq_a(x), self._fq_w(inner.weight), inner.bias,
+                        stride=inner._stride, padding=inner._padding,
+                        dilation=inner._dilation, groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+class QuantizedConv2DTranspose(Layer):
+    """QAT wrapper over nn.Conv2DTranspose (reference: quant_layers.py)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__()
+        self.inner = layer
+        self._fq_w = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                quant_axis=0)
+        self._fq_a = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                  quant_bits=activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+        inner = self.inner
+        return F.conv2d_transpose(
+            self._fq_a(x), self._fq_w(inner.weight), inner.bias,
+            stride=inner._stride, padding=inner._padding,
+            dilation=inner._dilation, groups=inner._groups,
+            output_padding=getattr(inner, "_output_padding", 0),
+            data_format=inner._data_format)
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer and observe its output scale (reference:
+    quant_layers.py MAOutputScaleLayer)."""
+
+    def __init__(self, layer, moving_rate=0.9, name=None, dtype="float32"):
+        super().__init__()
+        self.inner = layer
+        self._scale = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, *args, **kwargs):
+        out = self.inner(*args, **kwargs)
+        return self._scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer, fake-quantizing its output with an EMA range."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, **kw):
+        super().__init__()
+        self.inner = layer
+        self._fq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                quant_bits=activation_bits)
+
+    def forward(self, *args, **kwargs):
+        return self._fq(self.inner(*args, **kwargs))
+
+
+class FloatFunctionalLayer(Layer):
+    """Elementwise ops as layers so quant passes can hook them
+    (reference: nn/quant/functional_layers.py)."""
+
+    def __init__(self):
+        super().__init__()
+
+
+def _make_functional(opname):
+    class _Op(FloatFunctionalLayer):
+        def forward(self, x, y, name=None):
+            from ... import tensor as T
+            return getattr(T, opname)(x, y)
+    _Op.__name__ = opname
+    return _Op
+
+
+add = _make_functional("add")
+subtract = _make_functional("subtract")
+multiply = _make_functional("multiply")
+divide = _make_functional("divide")

@@ -1,0 +1,164 @@
+"""nn.utils: weight reparametrizations + parameter/vector helpers.
+
+reference parity: python/paddle/nn/utils/weight_norm_hook.py
+(WeightNorm:32 — g * v/||v|| recomputed every forward via a pre-hook),
+spectral_norm_hook.py, and paddle.nn.utils.parameters_to_vector /
+vector_to_parameters (nn/utils/transform_parameters.py).
+
+TPU-native: the hook recomputes the effective weight INSIDE the traced
+forward, so under jit the renormalization fuses into the step (no
+eager-side mutation); g and v are the leaf parameters the optimizer and
+ZeRO sharding see.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim: int):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+
+    def _n(a):
+        return jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2, axis=axes,
+                                keepdims=True))
+
+    from ...core.tensor import apply
+    return apply(_n, v, name="weight_norm_norm")
+
+
+class _WeightNormHook:
+    def __init__(self, name: str, dim: int):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        norm = _norm_except(v, self.dim)
+        from ...core.tensor import apply
+        w = apply(lambda gv, vv, nv: (gv / nv) * vv.astype(jnp.float32),
+                  g, v, norm, name="weight_norm_apply")
+        object.__setattr__(layer, self.name, w)
+        return None
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparametrize `layer.<name>` as g * v / ||v|| (reference:
+    nn/utils/weight_norm_hook.py weight_norm)."""
+    w = layer._parameters.pop(name)
+    v = layer.create_parameter(tuple(w.shape), dtype=str(w.dtype))
+    v._data = w._data
+    layer.add_parameter(name + "_v", v)
+    norm = _norm_except(v, dim)
+    g = layer.create_parameter(tuple(norm.shape), dtype="float32")
+    g._data = norm._data
+    layer.add_parameter(name + "_g", g)
+    setattr(layer, "_wn_hook_" + name,
+            layer.register_forward_pre_hook(_WeightNormHook(name, dim)))
+    setattr(layer, "_wn_dim_" + name, dim)
+    # materialize once so layer.weight exists before the first forward
+    _WeightNormHook(name, dim)(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g*v/||v|| back into a plain parameter (reference:
+    remove_weight_norm)."""
+    remover = getattr(layer, "_wn_hook_" + name, None)
+    if remover is None:
+        raise ValueError(f"{name!r} is not weight-normed on {layer}")
+    remover.remove()
+    dim = getattr(layer, "_wn_dim_" + name, 0)
+    delattr(layer, "_wn_hook_" + name)
+    if hasattr(layer, "_wn_dim_" + name):
+        delattr(layer, "_wn_dim_" + name)
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    norm = _norm_except(v, dim)
+    w = layer.create_parameter(tuple(v.shape), dtype=str(v.dtype))
+    w._data = ((g._data / norm._data) * v._data.astype(jnp.float32)) \
+        .astype(v._data.dtype)
+    if hasattr(layer, name):           # drop the hook-era plain attribute
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    """Spectral normalization via the SpectralNorm layer's math applied as
+    a pre-hook (reference: nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    shape = tuple(w.shape)
+    h = shape[dim]
+    rng = np.random.default_rng(0)
+    u0 = Tensor(jnp.asarray(rng.normal(size=(h,)).astype(np.float32)))
+    # persistent power-iteration state: warm-started every forward so
+    # sigma converges across steps (reference keeps u as a buffer)
+    layer.register_buffer("_sn_u_" + name, u0, persistable=True)
+
+    def hook(lyr, inputs):
+        import jax as _jax
+
+        from ...core.tensor import apply
+        wv = lyr._parameters[name + "_orig"]
+        u = lyr._buffers["_sn_u_" + name]
+
+        def _sn(a, uu):
+            mat = jnp.moveaxis(a.astype(jnp.float32), dim, 0).reshape(h, -1)
+            uv = uu
+            for _ in range(n_power_iterations):
+                vv = mat.T @ uv
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uv = mat @ vv
+                uv = uv / (jnp.linalg.norm(uv) + eps)
+            sigma = uv @ mat @ vv
+            return ((a.astype(jnp.float32) / sigma).astype(a.dtype), uv)
+
+        eff, u_new = apply(_sn, wv, u, name="spectral_norm_apply")
+        u._data = _jax.lax.stop_gradient(u_new._data)
+        object.__setattr__(lyr, name, eff)
+        return None
+
+    layer._parameters[name + "_orig"] = layer._parameters.pop(name)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten-concat parameters (reference:
+    nn/utils/transform_parameters.py)."""
+    from ...core.tensor import apply
+    params = list(parameters)
+
+    def _cat(*arrs):
+        return jnp.concatenate([a.reshape(-1) for a in arrs])
+
+    return apply(_cat, *params, name="parameters_to_vector")
+
+
+def vector_to_parameters(vec: Tensor, parameters) -> None:
+    """Write a flat vector back into parameters in order."""
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = data[off:off + n].reshape(tuple(p.shape)) \
+            .astype(p._data.dtype)
+        off += n
+    if off != data.shape[0]:
+        raise ValueError(f"vector length {data.shape[0]} != total "
+                         f"parameter size {off}")

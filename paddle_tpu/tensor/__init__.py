@@ -19,6 +19,7 @@ from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .tail import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
 from .sequence import *  # noqa: F401,F403

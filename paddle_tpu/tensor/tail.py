@@ -1,0 +1,207 @@
+"""Top-level API tail: small ops, inplace variants, and utility shims
+closing the last gaps against the reference's `paddle.*` export list
+(reference: python/paddle/__init__.py __all__; tensor/math.py addmm:1423,
+tensor/manipulation.py broadcast_tensors, tensor/attribute.py rank/shape,
+framework Tensor inplace methods reshape_/squeeze_/...).
+
+Inplace variants on an immutable-array runtime: jax arrays cannot mutate,
+so `x.op_()` computes functionally and REBINDS the tensor's buffer —
+observable semantics (returns x, x changed) match the reference; aliasing
+views of x do NOT see the change, which the reference forbids under
+autograd anyway (inplace on leaf vars raises there).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "addmm", "broadcast_tensors", "conj", "diagonal", "floor_mod",
+    "reverse", "rank", "shape", "reshape_", "scatter_", "squeeze_",
+    "tanh_", "unsqueeze_", "create_parameter", "batch", "check_shape",
+    "set_printoptions", "disable_signal_handler", "flops",
+]
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """out = beta*input + alpha*(x @ y) (reference: tensor/math.py addmm)."""
+    from ..core.flags import matmul_precision
+    prec = matmul_precision()
+    return apply(lambda i, a, b: beta * i
+                 + alpha * jnp.matmul(a, b, precision=prec),
+                 input, x, y, name="addmm")
+
+
+def broadcast_tensors(inputs, name=None):
+    """Broadcast a list of tensors to their common shape."""
+    shapes = [tuple(t.shape) for t in inputs]
+    target = np.broadcast_shapes(*shapes)
+    return [apply(lambda a, s=target: jnp.broadcast_to(a, s), t,
+                  name="broadcast_tensors") for t in inputs]
+
+
+def conj(x, name=None):
+    return apply(jnp.conj, x, name="conj")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2),
+                 x, name="diagonal")
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+def reverse(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return apply(lambda a: jnp.flip(a, axis=axes), x, name="reverse")
+
+
+def rank(x, name=None):
+    return apply(lambda a: jnp.asarray(a.ndim, jnp.int32), x, name="rank")
+
+
+def shape(x, name=None):
+    """Runtime shape as an int32 tensor (reference: fluid shape op)."""
+    return apply(lambda a: jnp.asarray(a.shape, jnp.int32), x, name="shape")
+
+
+# -- inplace variants -------------------------------------------------------
+
+
+def _rebind(x: Tensor, new: Tensor) -> Tensor:
+    x._data = new._data
+    if hasattr(new, "_node") and new._node is not None:
+        x._node = new._node
+    return x
+
+
+def reshape_(x, shape, name=None):
+    from .manipulation import reshape
+    return _rebind(x, reshape(x, shape))
+
+
+def squeeze_(x, axis=None, name=None):
+    from .manipulation import squeeze
+    return _rebind(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from .manipulation import unsqueeze
+    return _rebind(x, unsqueeze(x, axis))
+
+
+def tanh_(x, name=None):
+    return _rebind(x, apply(jnp.tanh, x, name="tanh_"))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .manipulation import scatter
+    return _rebind(x, scatter(x, index, updates, overwrite=overwrite))
+
+
+# -- utility shims ----------------------------------------------------------
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter creation (reference: paddle.create_parameter /
+    fluid/layers/tensor.py:77)."""
+    from ..nn.layer import Layer
+
+    holder = Layer()
+    p = holder.create_parameter(tuple(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference:
+    python/paddle/batch.py). Kept for legacy reader pipelines; new code
+    should use paddle.io.DataLoader."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: paddle.check_shape)."""
+    if isinstance(shape, Tensor):
+        return
+    for d in shape:
+        if isinstance(d, int) and d < -1:
+            raise ValueError(f"invalid dimension {d} in shape {shape}")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Numpy-backed print options (reference: paddle.set_printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference installs C++ fatal-signal hooks
+    (paddle/fluid/platform/init.cc); the python/JAX runtime has none to
+    disable."""
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count model matmul/conv FLOPs for one forward (reference:
+    hapi/dynamic_flops.py paddle.flops)."""
+    import jax
+
+    from ..core.tensor import no_grad
+    from ..nn.layer import Layer
+
+    if not isinstance(net, Layer):
+        raise TypeError("paddle.flops expects a Layer")
+    x = jnp.zeros(tuple(input_size), jnp.float32)
+
+    from ..jit.functional import bind, buffer_arrays, param_arrays
+    from ..core.random import trace_rng
+    params = param_arrays(net)
+    buffers = buffer_arrays(net)
+    was_training = net.training
+    net.eval()
+    try:
+        def fwd(p, xx):
+            with bind(net, p, dict(buffers)), no_grad(), \
+                    trace_rng(jax.random.key(0)):
+                out = net(Tensor(xx))
+            return out._data if isinstance(out, Tensor) else out
+
+        analysis = jax.jit(fwd).lower(params, x).cost_analysis() or {}
+        total = int(analysis.get("flops", 0))
+    finally:
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total

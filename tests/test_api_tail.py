@@ -1,0 +1,90 @@
+"""Top-level API tail (reference: python/paddle/__init__.py __all__)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_addmm_broadcast_conj_diagonal():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = paddle.to_tensor(np.ones((3, 2), np.float32))
+    inp = paddle.to_tensor(np.full((2, 2), 2.0, np.float32))
+    out = paddle.addmm(inp, x, y, beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * 2.0 + 2.0 * 3.0)
+
+    a, b = paddle.broadcast_tensors([
+        paddle.to_tensor(np.ones((1, 4), np.float32)),
+        paddle.to_tensor(np.ones((3, 1), np.float32))])
+    assert a.shape == [3, 4] and b.shape == [3, 4]
+
+    z = paddle.to_tensor(np.array([1 + 2j, 3 - 4j], np.complex64))
+    np.testing.assert_allclose(paddle.conj(z).numpy(),
+                               np.array([1 - 2j, 3 + 4j], np.complex64))
+
+    m = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose(paddle.diagonal(m).numpy(), [0, 4, 8])
+
+
+def test_inplace_variants_mutate_and_autograd():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    r = paddle.reshape_(x, (3, 2))
+    assert r is x and x.shape == [3, 2]
+    paddle.unsqueeze_(x, 0)
+    assert x.shape == [1, 3, 2]
+    paddle.squeeze_(x, 0)
+    assert x.shape == [3, 2]
+    t = paddle.to_tensor(np.zeros((2,), np.float32))
+    paddle.tanh_(t)
+    np.testing.assert_allclose(t.numpy(), 0.0)
+
+
+def test_rank_shape_reverse_floor_mod():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert int(paddle.rank(x)) == 2
+    assert paddle.shape(x).numpy().tolist() == [2, 3]
+    np.testing.assert_allclose(paddle.reverse(x, 1).numpy()[:, 0],
+                               [2.0, 5.0])
+    np.testing.assert_allclose(
+        paddle.floor_mod(paddle.to_tensor(np.array([7.0], np.float32)),
+                         paddle.to_tensor(np.array([3.0], np.float32)))
+        .numpy(), [1.0])
+
+
+def test_create_parameter_and_batch_reader():
+    p = paddle.create_parameter((4, 4), dtype="float32")
+    assert tuple(p.shape) == (4, 4) and not p.stop_gradient
+
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5]]
+
+
+def test_flops_counts_matmuls():
+    import paddle_tpu.nn as nn
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(32, 64)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    n = paddle.flops(M(), (8, 32))
+    assert n >= 2 * 8 * 32 * 64      # at least the gemm
+
+
+def test_places_and_dtype_exports():
+    assert paddle.CUDAPinnedPlace is paddle.CPUPlace
+    assert paddle.NPUPlace is paddle.TPUPlace
+    assert paddle.dtype("float32") == np.float32
+    assert paddle.bool == np.bool_
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    paddle.set_printoptions(precision=4)
+    paddle.disable_signal_handler()
+    paddle.check_shape((2, -1, 3))

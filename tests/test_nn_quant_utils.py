@@ -1,0 +1,125 @@
+"""nn.quant fake-quant layers + nn.utils reparametrizations
+(reference: nn/quant/quant_layers.py, nn/utils/weight_norm_hook.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import quant as Q
+
+
+def test_fake_quant_absmax_forward_and_ste_grad():
+    x = paddle.to_tensor(np.linspace(-1, 1, 32).astype(np.float32))
+    x.stop_gradient = False
+    fq = Q.FakeQuantAbsMax(quant_bits=8)
+    y = fq(x)
+    # quantized to the 8-bit grid of absmax=1
+    grid = np.round(np.linspace(-1, 1, 32) * 127) / 127
+    np.testing.assert_allclose(y.numpy(), grid.astype(np.float32),
+                               atol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), 1.0)  # STE
+
+
+def test_fake_quant_channel_wise():
+    w = paddle.to_tensor(np.stack([np.linspace(-1, 1, 8),
+                                   np.linspace(-4, 4, 8)]).astype(np.float32))
+    fq = Q.FakeQuantChannelWiseAbsMax(quant_axis=0)
+    y = fq(w).numpy()
+    assert abs(y[0].max() - 1.0) < 1e-3 and abs(y[1].max() - 4.0) < 1e-2
+    # each channel keeps its own scale: row 1 error 4x row 0 error
+    assert np.abs(y[1] - w.numpy()[1]).max() <= 4 / 127 + 1e-6
+
+
+def test_moving_average_fake_quant_updates_in_train_only():
+    fq = Q.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+    x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+    fq.train()
+    fq(x)
+    s1 = float(fq.scale._data)
+    assert s1 > 1.0                      # moved toward absmax=2
+    fq.eval()
+    fq(paddle.to_tensor(np.full((4,), 100.0, np.float32)))
+    assert float(fq.scale._data) == s1   # frozen in eval
+
+
+def test_quantized_linear_and_conv_wrappers_train():
+    paddle.seed(0)
+    lin = nn.Linear(16, 8)
+    qlin = Q.QuantizedLinear(lin)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(4, 16)).astype(np.float32))
+    ref = lin(x).numpy()
+    qlin.train()
+    for _ in range(30):      # warm the moving-average activation range
+        qlin(x)
+    out = qlin(x).numpy()
+    assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 1e-3
+
+    conv = nn.Conv2D(3, 6, 3)
+    qconv = Q.QuantizedConv2D(conv)
+    xi = paddle.to_tensor(np.random.default_rng(1)
+                          .normal(size=(2, 3, 8, 8)).astype(np.float32))
+    refc = conv(xi).numpy()
+    qconv.train()
+    for _ in range(30):
+        qconv(xi)
+    outc = qconv(xi).numpy()
+    assert np.abs(outc - refc).max() < 0.15 * np.abs(refc).max() + 1e-3
+
+
+def test_output_scale_layers():
+    lin = nn.Linear(4, 4)
+    wrapped = Q.MAOutputScaleLayer(lin)
+    wrapped.train()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = wrapped(x)
+    np.testing.assert_allclose(out.numpy(), lin(x).numpy())  # observe only
+    assert float(wrapped._scale.scale._data) != 1.0  # EMA actually moved
+
+
+def test_weight_norm_roundtrip():
+    paddle.seed(1)
+    lin = nn.Linear(8, 4)
+    w0 = np.asarray(lin.weight._data).copy()
+    nn.utils.weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names \
+        and "weight" not in names
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .normal(size=(3, 8)).astype(np.float32))
+    out1 = lin(x).numpy()
+    # reconstruction: g*v/||v|| == original weight right after wrapping
+    ref = x.numpy() @ w0 + np.asarray(lin.bias._data)
+    np.testing.assert_allclose(out1, ref, atol=1e-5, rtol=1e-5)
+    # g is trainable: grads flow to g and v, not to a dense weight
+    loss = lin(x).sum()
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    nn.utils.remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin(x).numpy(), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_parameters_vector_roundtrip():
+    paddle.seed(2)
+    lin = nn.Linear(6, 3)
+    vec = nn.utils.parameters_to_vector(lin.parameters())
+    assert vec.shape[0] == 6 * 3 + 3
+    new = [p for p in nn.Linear(6, 3).parameters()]
+    nn.utils.vector_to_parameters(vec, new)
+    for a, b in zip(lin.parameters(), new):
+        np.testing.assert_allclose(np.asarray(a._data),
+                                   np.asarray(b._data))
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(3)
+    lin = nn.Linear(12, 12)
+    lin.weight._data = lin.weight._data * 10.0     # big spectral norm
+    nn.utils.spectral_norm(lin, n_power_iterations=5)
+    w = np.asarray(lin.weight._data if hasattr(lin.weight, "_data")
+                   else lin.weight)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.2, sigma
