@@ -11,7 +11,10 @@ import jax.numpy as jnp
 
 from ..core.tensor import apply
 
-__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle", "moe"]
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle", "moe",
+           "LookAhead", "ModelAverage", "optimizer"]
+
+from .optimizer import LookAhead, ModelAverage  # noqa: E402
 
 
 def __getattr__(name):

@@ -1,0 +1,168 @@
+"""Incubate optimizers: LookAhead and ModelAverage.
+
+reference parity: python/paddle/incubate/optimizer/lookahead.py
+(LookAhead:25 — slow/fast weights, slow += alpha*(fast-slow) every k
+steps) and python/paddle/incubate/optimizer/modelaverage.py
+(ModelAverage:29 — sum/accumulator windows with apply()/restore()).
+
+TPU-native: both are pure pytree updates over the wrapped optimizer's
+parameter list — no program rewrite; the slow-weight/average state lives
+host-side per parameter and the blends run as single fused jnp ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (Zhang et al. 2019; reference:
+    incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not isinstance(k, int) or k <= 0:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow: Dict[int, jnp.ndarray] = {}
+        self._k_count = 0
+
+    # delegate the Optimizer surface to the wrapped optimizer
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, lr):
+        return self.inner_optimizer.set_lr(lr)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def step(self):
+        params = [p for p in (self.inner_optimizer._parameter_list or [])]
+        for p in params:
+            if id(p) not in self._slow:
+                # COPY: the inner optimizer's fused step donates param
+                # buffers, which would invalidate an aliased snapshot
+                self._slow[id(p)] = jnp.array(p._data, copy=True)
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            a = self.alpha
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + a * (p._data - slow)
+                p._data = slow                       # fast snaps to slow
+                # keep an independent buffer: p's copy will be donated
+                self._slow[id(p)] = jnp.array(slow, copy=True)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        import numpy as np
+        out = self.inner_optimizer.state_dict()
+        out["lookahead_k_count"] = self._k_count
+        for i, p in enumerate(self.inner_optimizer._parameter_list or []):
+            if id(p) in self._slow:
+                out[f"lookahead_slow{i}"] = np.asarray(self._slow[id(p)])
+        return out
+
+    def set_state_dict(self, state):
+        self._k_count = int(state.pop("lookahead_k_count", 0))
+        for i, p in enumerate(self.inner_optimizer._parameter_list or []):
+            key = f"lookahead_slow{i}"
+            if key in state:
+                self._slow[id(p)] = jnp.asarray(state.pop(key))
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters applied at eval time (reference:
+    incubate/optimizer/modelaverage.py).
+
+    Usage: call step() (or let the training optimizer do its own step and
+    call `model_average.step()` after it), then evaluate inside
+    `with model_average.apply(): ...`; weights restore on exit.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._num: Dict[int, int] = {}
+        # previous window (reference keeps sum_1/sum_2 tiers so the
+        # average still spans the last full window right after a restart)
+        self._old_sum: Dict[int, jnp.ndarray] = {}
+        self._old_num: Dict[int, int] = {}
+        self._updates = 0
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def step(self):
+        self._updates += 1
+        for p in self._ensure_params():
+            if id(p) not in self._sum:
+                self._sum[id(p)] = jnp.zeros_like(p._data)
+                self._num[id(p)] = 0
+                self._old_sum[id(p)] = jnp.zeros_like(p._data)
+                self._old_num[id(p)] = 0
+            n = self._num[id(p)]
+            threshold = min(self.max_window,
+                            max(self.min_window,
+                                int(self.avg_rate * self._updates) or 1))
+            if n >= threshold:
+                # roll the window: current becomes old, restart current
+                self._old_sum[id(p)] = self._sum[id(p)]
+                self._old_num[id(p)] = n
+                self._sum[id(p)] = jnp.zeros_like(p._data)
+                n = 0
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+            self._num[id(p)] = n + 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights (reference: ModelAverage.apply)."""
+        self._backup = {}
+        for p in self._ensure_params():
+            if self._num.get(id(p), 0) == 0:
+                continue
+            self._backup[id(p)] = p._data
+            total = self._sum[id(p)] + self._old_sum[id(p)]
+            count = self._num[id(p)] + self._old_num[id(p)]
+            p._data = (total / count).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._ensure_params():
+                if id(p) in self._backup:
+                    p._data = self._backup[id(p)]
+        self._backup = None

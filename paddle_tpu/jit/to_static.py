@@ -701,12 +701,14 @@ class TranslatedLayer:
                             "not both")
         if feeds:
             # Executor.run feeds by name: exports name inputs 'x0','x1',...
+            n_in = len(self._meta.get("input_spec") or []) or len(feeds)
+
             def idx(n):
                 if not (n.startswith("x") and n[1:].isdigit()):
                     raise KeyError(
                         f"unknown feed {n!r}: a jit.save export names its "
                         f"inputs positionally as "
-                        f"{['x%d' % i for i in range(len(feeds))]}")
+                        f"{['x%d' % i for i in range(n_in)]}")
                 return int(n[1:])
             inputs = [feeds[k] for k in sorted(feeds, key=idx)]
         raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)

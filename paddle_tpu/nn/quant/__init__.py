@@ -89,20 +89,25 @@ class FakeQuantMovingAverageAbsMax(Layer):
         self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)),
                              persistable=True)
 
+    def update_range(self, x):
+        """EMA absmax update (shared with the pure observer layer)."""
+        rate = self.moving_rate
+
+        def _update(a, sc, st):
+            absmax = jnp.max(jnp.abs(a))
+            st2 = st * rate + 1.0
+            sc2 = (sc * rate * st + absmax) / st2
+            return sc2, st2
+
+        sc2, st2 = apply(_update, x, self.scale, self.state,
+                         name="moving_average_abs_max_update")
+        self.scale._data = jax.lax.stop_gradient(sc2._data)
+        self.state._data = jax.lax.stop_gradient(st2._data)
+
     def forward(self, x):
         qmax = 2.0 ** (self.quant_bits - 1) - 1
-        rate = self.moving_rate
         if self.training:
-            def _update(a, sc, st):
-                absmax = jnp.max(jnp.abs(a))
-                st2 = st * rate + 1.0
-                sc2 = (sc * rate * st + absmax) / st2
-                return sc2, st2
-
-            sc2, st2 = apply(_update, x, self.scale, self.state,
-                             name="moving_average_abs_max_update")
-            self.scale._data = jax.lax.stop_gradient(sc2._data)
-            self.state._data = jax.lax.stop_gradient(st2._data)
+            self.update_range(x)
 
         def _fq(a, sc):
             s = jnp.maximum(sc / qmax, 1e-9)
@@ -126,19 +131,7 @@ class MovingAverageAbsMaxScale(Layer):
 
     def forward(self, x):
         if self.training:
-            fq = self._fq
-            rate = fq.moving_rate
-
-            def _update(a, sc, st):
-                absmax = jnp.max(jnp.abs(a))
-                st2 = st * rate + 1.0
-                sc2 = (sc * rate * st + absmax) / st2
-                return sc2, st2
-
-            sc2, st2 = apply(_update, x, fq.scale, fq.state,
-                             name="moving_average_abs_max_update")
-            fq.scale._data = jax.lax.stop_gradient(sc2._data)
-            fq.state._data = jax.lax.stop_gradient(st2._data)
+            self._fq.update_range(x)    # observe only, no quantize pass
         return x
 
 
@@ -186,14 +179,16 @@ class QuantizedConv2D(Layer):
 
 
 class QuantizedConv2DTranspose(Layer):
-    """QAT wrapper over nn.Conv2DTranspose (reference: quant_layers.py)."""
+    """QAT wrapper over nn.Conv2DTranspose (reference: quant_layers.py).
+    Transpose-conv filters are (in, out//groups, kh, kw): output channels
+    live on axis 1, so channel-wise scales quantize along quant_axis=1."""
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
                  moving_rate=0.9, **kw):
         super().__init__()
         self.inner = layer
         self._fq_w = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
-                                                quant_axis=0)
+                                                quant_axis=1)
         self._fq_a = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
                                                   quant_bits=activation_bits)
 

@@ -45,7 +45,9 @@ class _WeightNormHook:
         v = getattr(layer, self.name + "_v")
         norm = _norm_except(v, self.dim)
         from ...core.tensor import apply
-        w = apply(lambda gv, vv, nv: (gv / nv) * vv.astype(jnp.float32),
+        w = apply(lambda gv, vv, nv: ((gv / nv)
+                                      * vv.astype(jnp.float32))
+                  .astype(vv.dtype),
                   g, v, norm, name="weight_norm_apply")
         object.__setattr__(layer, self.name, w)
         return None

@@ -23,6 +23,7 @@ __all__ = [
     "reverse", "rank", "shape", "reshape_", "scatter_", "squeeze_",
     "tanh_", "unsqueeze_", "create_parameter", "batch", "check_shape",
     "set_printoptions", "disable_signal_handler", "flops",
+    "diag_embed", "fill_diagonal_", "clip_by_norm", "edit_distance",
 ]
 
 
@@ -76,9 +77,7 @@ def shape(x, name=None):
 
 
 def _rebind(x: Tensor, new: Tensor) -> Tensor:
-    x._data = new._data
-    if hasattr(new, "_node") and new._node is not None:
-        x._node = new._node
+    x._adopt(new)        # value + tape link + out_ref bookkeeping
     return x
 
 
@@ -205,3 +204,104 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     if print_detail:
         print(f"Total FLOPs: {total}")
     return total
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal-matrix construction (reference: tensor/creation.py
+    diag_embed / operators/diag_embed_op.cc)."""
+
+    def _de(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        perm = [i for i in range(out.ndim) if i not in (out.ndim - 2,
+                                                        out.ndim - 1)]
+        order = list(perm)
+        for pos, axis in sorted([(d1, out.ndim - 2), (d2, out.ndim - 1)]):
+            order.insert(pos, axis)
+        return jnp.transpose(out, order)
+
+    return apply(_de, input, name="diag_embed")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Inplace diagonal fill (reference: tensor Tensor.fill_diagonal_ /
+    operators/fill_diagonal_op.cc)."""
+
+    def _fd(a):
+        # true diagonal length for rectangular matrices with offset
+        n = min(a.shape[-2] - max(-offset, 0), a.shape[-1] - max(offset, 0))
+        idx = jnp.arange(max(n, 0))
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = a.at[..., r, c].set(value)
+        if wrap and a.ndim == 2 and a.shape[0] > a.shape[1]:
+            # numpy-style wrapped fill for tall matrices
+            step = a.shape[1] + 1
+            rows = jnp.arange(0, a.shape[0] * a.shape[1], step)
+            flat = out.reshape(-1).at[rows].set(value)
+            out = flat.reshape(a.shape)
+        return out
+
+    return _rebind(x, apply(_fd, x, name="fill_diagonal_"))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Rescale x so ||x||_2 <= max_norm (reference:
+    operators/clip_by_norm_op.h)."""
+
+    def _cbn(a):
+        norm = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm,
+                                                                  1e-12),
+                          1.0)
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return apply(_cbn, x, name="clip_by_norm")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between id sequences (reference:
+    operators/edit_distance_op.cc — a host-side DP there too; the output
+    feeds metrics, not gradients, so this runs on host numpy).
+
+    Returns (distance [B, 1] float32, sequence_num [1] int64)."""
+    hyp = np.asarray(input._data if isinstance(input, Tensor) else input)
+    ref = np.asarray(label._data if isinstance(label, Tensor) else label)
+    hl = (np.asarray(input_length._data if isinstance(input_length, Tensor)
+                     else input_length).reshape(-1)
+          if input_length is not None else None)
+    rl = (np.asarray(label_length._data if isinstance(label_length, Tensor)
+                     else label_length).reshape(-1)
+          if label_length is not None else None)
+    ignored = set(ignored_tokens or ())
+
+    def seq(row, ln):
+        s = row[:int(ln)] if ln is not None else row
+        return [t for t in s.tolist() if t not in ignored]
+
+    B = hyp.shape[0]
+    out = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        h = seq(hyp[b], hl[b] if hl is not None else None)
+        r = seq(ref[b], rl[b] if rl is not None else None)
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (h[i - 1] != r[j - 1]))
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[b, 0] = d
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.array([B], np.int64))))

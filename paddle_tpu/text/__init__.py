@@ -8,4 +8,7 @@ from .tokenizer import (BasicTokenizer, FasterTokenizer,  # noqa: F401
 
 __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
            "WMT14", "WMT16", "BasicTokenizer", "FasterTokenizer",
-           "WordpieceTokenizer", "load_vocab"]
+           "WordpieceTokenizer", "load_vocab", "viterbi_decode", "ViterbiDecoder",
+]
+
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: E402,F401

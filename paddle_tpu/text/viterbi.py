@@ -1,0 +1,100 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py,
+paddle/fluid/operators/viterbi_decode_op.h).
+
+Semantics follow the reference op: `transitions` is [C, C]; with
+include_bos_eos_tag=True the last row is the start-tag transition
+(added to step 0) and the second-to-last row is the stop-tag transition
+(added at each sequence's final valid step) — the row split the kernel
+performs at viterbi_decode_op.h:319-338.
+
+TPU-native: the whole decode is one `lax.scan` forward (max-product with
+stored backpointers, length-masked carries) plus one reversed scan for
+the backtrace — static shapes, fully jittable, batched over B on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(emissions, trans, lengths, include_bos_eos_tag):
+    B, L, C = emissions.shape
+    lengths = lengths.astype(jnp.int32)
+    if include_bos_eos_tag:
+        start_row = trans[C - 1]            # start -> tag
+        stop_row = trans[C - 2]             # stop-tag row (kernel's split)
+    else:
+        start_row = jnp.zeros((C,), trans.dtype)
+        stop_row = jnp.zeros((C,), trans.dtype)
+
+    alpha0 = emissions[:, 0, :] + start_row[None, :]
+    # a length-1 sequence stops immediately
+    alpha0 = alpha0 + jnp.where((lengths == 1)[:, None], stop_row[None, :],
+                                0.0)
+
+    def step(alpha, inp):
+        emit_t, t = inp                      # emit_t: [B, C]
+        # scores[i, j] = alpha[i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, C]
+        alpha_new = jnp.max(scores, axis=1) + emit_t
+        alpha_new = alpha_new + jnp.where(
+            (lengths == t + 1)[:, None], stop_row[None, :], 0.0)
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, alpha_new, alpha)
+        # frozen steps keep the identity backpointer so the backtrace
+        # passes through them untouched
+        bp = jnp.where(active, best_prev,
+                       jnp.arange(C, dtype=best_prev.dtype)[None, :])
+        return alpha, bp
+
+    ts = jnp.arange(1, L, dtype=jnp.int32)
+    alpha, bps = lax.scan(step, alpha0,
+                          (jnp.moveaxis(emissions[:, 1:, :], 1, 0), ts))
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    first_tag, rev_path = lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([first_tag[None], rev_path], axis=0)   # [L, B]
+    path = jnp.moveaxis(path, 0, 1).astype(jnp.int64)             # [B, L]
+    # positions at/after each length are padding: zero them
+    mask = jnp.arange(L)[None, :] < lengths[:, None]
+    return scores, jnp.where(mask, path, 0)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path (reference: text/viterbi_decode.py).
+
+    potentials [B, L, C] float; transition_params [C, C]; lengths [B]
+    int64. Returns (scores [B], paths [B, L] int64)."""
+
+    def _vd(e, t, ln):
+        return _viterbi(e, t, ln, include_bos_eos_tag)
+
+    return apply(_vd, potentials, transition_params, lengths,
+                 name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Decoder layer holding the flag (reference: ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

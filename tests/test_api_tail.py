@@ -88,3 +88,46 @@ def test_places_and_dtype_exports():
     paddle.set_printoptions(precision=4)
     paddle.disable_signal_handler()
     paddle.check_shape((2, -1, 3))
+
+
+def test_diag_embed_fill_diagonal_clip_edit_distance():
+    v = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    m = paddle.diag_embed(v)
+    assert m.shape == [2, 2, 2]
+    np.testing.assert_allclose(m.numpy()[0], [[1, 0], [0, 2]])
+    mo = paddle.diag_embed(v, offset=1)
+    assert mo.shape == [2, 3, 3]
+    np.testing.assert_allclose(mo.numpy()[1],
+                               [[0, 3, 0], [0, 0, 4], [0, 0, 0]])
+
+    x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    paddle.fill_diagonal_(x, 5.0)
+    np.testing.assert_allclose(x.numpy(), np.eye(3) * 5.0)
+
+    big = paddle.to_tensor(np.full((4,), 10.0, np.float32))
+    clipped = paddle.clip_by_norm(big, 5.0)
+    np.testing.assert_allclose(np.linalg.norm(clipped.numpy()), 5.0,
+                               rtol=1e-5)
+    small = paddle.to_tensor(np.full((4,), 0.1, np.float32))
+    np.testing.assert_allclose(paddle.clip_by_norm(small, 5.0).numpy(),
+                               small.numpy())
+
+    hyp = paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64))
+    ref = paddle.to_tensor(np.array([[1, 3, 3, 0]], np.int64))
+    d, n = paddle.edit_distance(hyp, ref, normalized=False,
+                                input_length=np.array([3]),
+                                label_length=np.array([3]))
+    assert float(d.numpy()[0, 0]) == 1.0 and int(n.numpy()[0]) == 1
+    dn, _ = paddle.edit_distance(hyp, ref, normalized=True,
+                                 input_length=np.array([3]),
+                                 label_length=np.array([3]))
+    np.testing.assert_allclose(float(dn.numpy()[0, 0]), 1 / 3, rtol=1e-5)
+
+
+def test_fill_diagonal_rectangular_offsets():
+    x = paddle.to_tensor(np.zeros((5, 3), np.float32))
+    paddle.fill_diagonal_(x, 7.0, offset=-2)
+    got = x.numpy()
+    expect = np.zeros((5, 3), np.float32)
+    expect[2, 0] = expect[3, 1] = expect[4, 2] = 7.0
+    np.testing.assert_allclose(got, expect)

@@ -1,0 +1,93 @@
+"""viterbi_decode golden tests + incubate LookAhead/ModelAverage
+(reference: text/viterbi_decode.py, incubate/optimizer/)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _brute_force(emit, trans, length, include):
+    """Enumerate all tag paths (golden reference)."""
+    import itertools
+    C = emit.shape[-1]
+    best, best_path = -1e30, None
+    for path in itertools.product(range(C), repeat=length):
+        s = emit[0, path[0]]
+        if include:
+            s += trans[C - 1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+        if include:
+            s += trans[C - 2, path[length - 1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_brute_force():
+    rng = np.random.default_rng(0)
+    B, L, C = 3, 5, 4
+    emit = rng.normal(size=(B, L, C)).astype(np.float32)
+    trans = rng.normal(size=(C, C)).astype(np.float32)
+    lengths = np.array([5, 3, 1], np.int64)
+    for include in (False, True):
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=include)
+        for b in range(B):
+            bs, bp = _brute_force(emit[b], trans, int(lengths[b]), include)
+            assert abs(float(scores.numpy()[b]) - bs) < 1e-4, (b, include)
+            got = paths.numpy()[b, :int(lengths[b])].tolist()
+            assert got == bp, (b, include, got, bp)
+            assert (paths.numpy()[b, int(lengths[b]):] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.default_rng(1)
+    emit = paddle.to_tensor(rng.normal(size=(2, 4, 3)).astype(np.float32))
+    trans = paddle.to_tensor(rng.normal(size=(3, 3)).astype(np.float32))
+    dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, paths = dec(emit, paddle.to_tensor(np.array([4, 4], np.int64)))
+    assert scores.shape == [2] and paths.shape == [2, 4]
+
+
+def test_lookahead_slow_fast_blend():
+    from paddle_tpu import nn
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    w0 = np.asarray(lin.weight._data).copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for step in range(2):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k=2 steps: fast took 2 sgd steps, then w = w0 + 0.5*(fast-w0)
+    fast = w0 - 0.1 * np.ones((4, 4)) * 2 * 2   # dL/dw = sum over batch(2)
+    expect = w0 + 0.5 * (fast - w0)
+    np.testing.assert_allclose(np.asarray(lin.weight._data), expect,
+                               atol=1e-5)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu import nn
+    paddle.seed(1)
+    lin = nn.Linear(3, 3)
+    ma = paddle.incubate.ModelAverage(0.5, parameters=lin.parameters(),
+                                      min_average_window=2,
+                                      max_average_window=100)
+    vals = []
+    for v in (1.0, 2.0, 3.0):
+        lin.weight._data = np.full((3, 3), v, np.float32) * 1.0
+        import jax.numpy as jnp
+        lin.weight._data = jnp.asarray(lin.weight._data)
+        ma.step()
+        vals.append(v)
+    cur = np.asarray(lin.weight._data).copy()
+    with ma.apply():
+        avg = np.asarray(lin.weight._data)
+        np.testing.assert_allclose(avg, np.mean(vals), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
