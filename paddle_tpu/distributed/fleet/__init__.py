@@ -16,12 +16,14 @@ from __future__ import annotations
 from typing import Optional
 
 from .. import env
+from .dataset import InMemoryDataset, QueueDataset
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 
 __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "InMemoryDataset", "QueueDataset",
            "CommunicateTopology", "get_hybrid_communicate_group",
            "distributed_model", "distributed_optimizer",
            "worker_index", "worker_num", "is_first_worker",

@@ -13,6 +13,8 @@ from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
 from .layers.rnn import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
+from .decode import (BeamSearchDecoder, Decoder,  # noqa: F401
+                     dynamic_decode, gather_tree)
 from . import quant  # noqa: F401
 from . import utils  # noqa: F401
 

@@ -1,0 +1,240 @@
+"""Decoding: BeamSearchDecoder + dynamic_decode + gather_tree.
+
+reference parity: python/paddle/fluid/layers/rnn.py — Decoder(:780),
+BeamSearchDecoder(:866: tile to [B*beam], log-prob accumulation, top-k
+over beam*vocab, finished/eos masking), dynamic_decode(:1583: while-op
+step loop), and operators/gather_tree_op.cc (parent-pointer backtrace).
+
+TPU-native redesign: the whole decode is ONE `lax.scan` over
+`max_step_num` with static shapes — no dynamic while-op, no growing
+arrays. Finished beams are masked (eos forced, scores frozen) rather
+than retired, which is exactly how you keep the MXU busy with a fixed
+[B*beam, ...] batch; the backtrace is a reversed scan (gather_tree).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+from .layer import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam parents into full sequences (reference:
+    operators/gather_tree_op.cc). ids/parents: [T, B, beam] -> [T, B, beam].
+    """
+
+    def _gt(idarr, par):
+        T = idarr.shape[0]
+
+        def back(beam_idx, t):
+            # beam_idx: [B, beam] — which beam each final path occupies
+            tok = jnp.take_along_axis(idarr[t], beam_idx, axis=1)
+            prev = jnp.take_along_axis(par[t], beam_idx, axis=1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(idarr.shape[2])[None, :],
+                                idarr.shape[1:])
+        _, toks = lax.scan(back, init, jnp.arange(T), reverse=True)
+        return toks
+
+    return apply(_gt, ids, parents, name="gather_tree")
+
+
+class Decoder:
+    """Base decode contract (reference: rnn.py Decoder:780)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a step cell (reference: rnn.py:866).
+
+    cell(inputs [B*beam, ...], states) -> (cell_out, new_states);
+    `output_fn(cell_out)` must produce vocab logits.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ["scores", "predicted_ids", "parent_ids"])
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ["cell_states", "log_probs", "finished", "lengths"])
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (for encoder outputs etc.)."""
+
+        def _tile(a):
+            return jnp.repeat(a, beam_size, axis=0)
+
+        if isinstance(x, Tensor):
+            return apply(_tile, x, name="tile_beam_merge_with_batch")
+        return jax.tree_util.tree_map(_tile, x)
+
+    def _merge(self, a):
+        """[B, beam, ...] -> [B*beam, ...]"""
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a, B):
+        """[B*beam, ...] -> [B, beam, ...]"""
+        return a.reshape((B, self.beam_size) + a.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        """Tile cell states to the beam; beam 0 active, rest -inf."""
+        states = jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a, self.beam_size, axis=0),
+            initial_cell_states)
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        B = leaf.shape[0] // self.beam_size
+        log_probs = jnp.tile(
+            jnp.array([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
+            (B, 1))
+        finished = jnp.zeros((B, self.beam_size), bool)
+        lengths = jnp.zeros((B, self.beam_size), jnp.int64)
+        init_inputs = jnp.full((B * self.beam_size,), self.start_token,
+                               jnp.int32)
+        return init_inputs, self.StateWrapper(states, log_probs, finished,
+                                              lengths), finished
+
+    @staticmethod
+    def _unwrap(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, tree,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    @staticmethod
+    def _wrap(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x if isinstance(x, Tensor) else Tensor(x), tree)
+
+    def step(self, time, inputs, states, **kwargs):
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(Tensor(inputs))
+            inputs = emb._data if isinstance(emb, Tensor) else emb
+        cell_out, next_cell_states = self.cell(
+            Tensor(inputs), self._wrap(states.cell_states), **kwargs)
+        next_cell_states = self._unwrap(next_cell_states)
+        if self.output_fn is not None:
+            out = self.output_fn(Tensor(cell_out) if not isinstance(
+                cell_out, Tensor) else cell_out)
+            cell_out = out._data if isinstance(out, Tensor) else out
+        elif isinstance(cell_out, Tensor):
+            cell_out = cell_out._data
+
+        V = cell_out.shape[-1]
+        B = states.log_probs.shape[0]
+        beam = self.beam_size
+        step_lp = jax.nn.log_softmax(cell_out.astype(jnp.float32), axis=-1)
+        step_lp = self._split(step_lp, B)                     # [B, bm, V]
+
+        # finished beams only extend with eos at zero cost
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[self.end_token].set(
+            0.0)
+        step_lp = jnp.where(states.finished[..., None], eos_only[None, None],
+                            step_lp)
+
+        total = states.log_probs[..., None] + step_lp         # [B, bm, V]
+        flat = total.reshape(B, beam * V)
+        top_scores, top_idx = lax.top_k(flat, beam)           # [B, beam]
+        parent = (top_idx // V).astype(jnp.int64)
+        token = (top_idx % V).astype(jnp.int64)
+
+        gather = lambda a: jnp.take_along_axis(a, parent, axis=1)
+        was_finished = gather(states.finished)
+        finished = was_finished | (token == self.end_token)
+        lengths = gather(states.lengths) + (~was_finished).astype(jnp.int64)
+
+        # reorder cell states by parent beam
+        flat_parent = (parent
+                       + (jnp.arange(B) * beam)[:, None]).reshape(-1)
+        next_cell_states = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, flat_parent, axis=0), next_cell_states)
+
+        outputs = self.OutputWrapper(top_scores, token, parent)
+        next_states = self.StateWrapper(next_cell_states, top_scores,
+                                        finished, lengths)
+        next_inputs = token.reshape(-1).astype(jnp.int32)
+        return outputs, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """outputs fields stacked [T, B, beam] -> backtraced ids."""
+        ids = gather_tree(Tensor(outputs.predicted_ids),
+                          Tensor(outputs.parent_ids))
+        return ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a decoder to completion (reference: rnn.py dynamic_decode:1583).
+
+    TPU-native: one lax.scan over `max_step_num` steps (static trip count;
+    finished beams are masked, not retired). Returns (outputs, final_states)
+    or (outputs, final_states, sequence_lengths) when return_length=True;
+    for BeamSearchDecoder `outputs` is the backtraced token tensor
+    [B, T, beam] ([T, B, beam] when output_time_major).
+    """
+    if max_step_num is None:
+        raise ValueError("max_step_num is required (static trip count "
+                         "keeps the decode jittable on TPU)")
+    raw_inits = jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, inits)
+    init_inputs, init_states, init_finished = decoder.initialize(raw_inits)
+
+    def scan_step(carry, t):
+        inputs, states, finished = carry
+        outputs, next_states, next_inputs, next_finished = decoder.step(
+            t, inputs, states, **kwargs)
+        next_finished = next_finished | finished if not \
+            decoder.tracks_own_finished else next_finished
+        return (next_inputs, next_states, next_finished), outputs
+
+    (last_inputs, final_states, finished), stacked = lax.scan(
+        scan_step, (init_inputs, init_states, init_finished),
+        jnp.arange(int(max_step_num)))
+
+    seq_len = getattr(final_states, "lengths", None)
+    outputs, final_states = decoder.finalize(stacked, final_states, seq_len)
+    if isinstance(outputs, Tensor):
+        out = outputs
+    else:
+        out = Tensor(outputs)
+    if not output_time_major:
+        def _bt(a):
+            return jnp.moveaxis(a, 0, 1)
+        out = apply(_bt, out, name="dynamic_decode_transpose")
+    if return_length:
+        return out, final_states, Tensor(seq_len) if seq_len is not None \
+            else None
+    return out, final_states
