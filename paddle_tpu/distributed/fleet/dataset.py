@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import random
 import subprocess
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Sequence
 
 import numpy as np
 
@@ -37,6 +37,10 @@ def _parse_multislot(line: str):
     while i < len(parts):
         n = int(parts[i])
         vals = parts[i + 1:i + 1 + n]
+        if len(vals) != n:
+            raise ValueError(
+                f"corrupt MultiSlot line: slot declares {n} values but "
+                f"{len(vals)} remain: {line[:120]!r}")
         out.append(np.asarray([float(v) for v in vals], np.float32))
         i += 1 + n
     return out
@@ -56,7 +60,11 @@ class DatasetBase:
 
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command=None, input_type=0, fs_name="", fs_ugi="",
-             download_cmd="cat", parse_fn=None, drop_last=False, **kwargs):
+             download_cmd="cat", parse_fn=None, drop_last=False,
+             shared_filelist=False, **kwargs):
+        # shared_filelist=True declares that EVERY trainer loads the same
+        # files, which is what makes the hash partition in global_shuffle
+        # a correct exchange substitute
         self.batch_size = batch_size
         self.thread_num = thread_num
         self.use_var = use_var or ()
@@ -64,6 +72,7 @@ class DatasetBase:
         if parse_fn is not None:
             self.parse_fn = parse_fn
         self.drop_last = drop_last
+        self.shared_filelist = shared_filelist
 
     def set_filelist(self, filelist):
         self.filelist = list(filelist)
@@ -73,17 +82,25 @@ class DatasetBase:
             proc = subprocess.Popen(self.pipe_command, shell=True,
                                     stdin=open(path, "rb"),
                                     stdout=subprocess.PIPE, text=True)
+            drained = False
             try:
                 for line in proc.stdout:
                     line = line.strip()
                     if line:
                         yield self.parse_fn(line)
+                drained = True
             finally:
                 proc.stdout.close()
-                if proc.wait() != 0:
-                    raise RuntimeError(
-                        f"pipe_command {self.pipe_command!r} failed on "
-                        f"{path}")
+                if drained:
+                    # only a fully-drained pipe reports failures; an early
+                    # consumer break (generator close) just kills the child
+                    if proc.wait() != 0:
+                        raise RuntimeError(
+                            f"pipe_command {self.pipe_command!r} failed "
+                            f"on {path}")
+                else:
+                    proc.kill()
+                    proc.wait()
         else:
             with open(path) as f:
                 for line in f:
@@ -152,6 +169,13 @@ class InMemoryDataset(DatasetBase):
         n = get_world_size()
         me = get_rank()
         if n > 1:
+            if not getattr(self, "shared_filelist", False):
+                raise RuntimeError(
+                    "global_shuffle with world_size > 1 requires "
+                    "init(shared_filelist=True) and the SAME full "
+                    "filelist on every trainer (each keeps its hash "
+                    "shard). With per-trainer split filelists the data "
+                    "is already partitioned — use local_shuffle().")
             self._memory = [s for i, s in enumerate(self._memory)
                             if hash((self._seed, i)) % n == me]
         self.local_shuffle()

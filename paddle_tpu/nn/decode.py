@@ -15,14 +15,12 @@ than retired, which is exactly how you keep the MXU busy with a fixed
 from __future__ import annotations
 
 import collections
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..core.tensor import Tensor, apply
-from .layer import Layer
 
 __all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
 
@@ -111,13 +109,20 @@ class BeamSearchDecoder(Decoder):
         states = jax.tree_util.tree_map(
             lambda a: jnp.repeat(a, self.beam_size, axis=0),
             initial_cell_states)
-        leaf = jax.tree_util.tree_leaves(states)[0]
+        leaves = jax.tree_util.tree_leaves(states)
+        if not leaves:
+            raise ValueError(
+                "BeamSearchDecoder needs initial cell states: pass "
+                "dynamic_decode(decoder, inits=<cell state pytree with a "
+                "[batch, ...] leading dim>, ...) — e.g. the encoder's "
+                "final hidden state")
+        leaf = leaves[0]
         B = leaf.shape[0] // self.beam_size
         log_probs = jnp.tile(
             jnp.array([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
             (B, 1))
         finished = jnp.zeros((B, self.beam_size), bool)
-        lengths = jnp.zeros((B, self.beam_size), jnp.int64)
+        lengths = jnp.zeros((B, self.beam_size), jnp.int32)
         init_inputs = jnp.full((B * self.beam_size,), self.start_token,
                                jnp.int32)
         return init_inputs, self.StateWrapper(states, log_probs, finished,
@@ -163,13 +168,13 @@ class BeamSearchDecoder(Decoder):
         total = states.log_probs[..., None] + step_lp         # [B, bm, V]
         flat = total.reshape(B, beam * V)
         top_scores, top_idx = lax.top_k(flat, beam)           # [B, beam]
-        parent = (top_idx // V).astype(jnp.int64)
-        token = (top_idx % V).astype(jnp.int64)
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
 
         gather = lambda a: jnp.take_along_axis(a, parent, axis=1)
         was_finished = gather(states.finished)
         finished = was_finished | (token == self.end_token)
-        lengths = gather(states.lengths) + (~was_finished).astype(jnp.int64)
+        lengths = gather(states.lengths) + (~was_finished).astype(jnp.int32)
 
         # reorder cell states by parent beam
         flat_parent = (parent
