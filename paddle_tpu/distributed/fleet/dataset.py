@@ -28,6 +28,17 @@ import numpy as np
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
 
 
+def _stable_mix(seed: int, i: int) -> int:
+    """Interpreter-independent sample->trainer hash (python's builtin
+    hash() is implementation-defined, so trainers on different runtimes
+    could partition inconsistently)."""
+    x = (seed * 0x9E3779B97F4A7C15 + i * 0xBF58476D1CE4E5B9) \
+        & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 31
+    x = (x * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 29)
+
+
 def _parse_multislot(line: str):
     """The reference MultiSlotDataFeed text format: for each slot,
     `<n> v1 ... vn` (floats); returns a list of np arrays, one per slot."""
@@ -79,8 +90,9 @@ class DatasetBase:
 
     def _read_file(self, path: str):
         if self.pipe_command:
+            stdin_f = open(path, "rb")
             proc = subprocess.Popen(self.pipe_command, shell=True,
-                                    stdin=open(path, "rb"),
+                                    stdin=stdin_f,
                                     stdout=subprocess.PIPE, text=True)
             drained = False
             try:
@@ -101,6 +113,7 @@ class DatasetBase:
                 else:
                     proc.kill()
                     proc.wait()
+                stdin_f.close()
         else:
             with open(path) as f:
                 for line in f:
@@ -177,7 +190,7 @@ class InMemoryDataset(DatasetBase):
                     "shard). With per-trainer split filelists the data "
                     "is already partitioned — use local_shuffle().")
             self._memory = [s for i, s in enumerate(self._memory)
-                            if hash((self._seed, i)) % n == me]
+                            if _stable_mix(self._seed, i) % n == me]
         self.local_shuffle()
 
     def release_memory(self):

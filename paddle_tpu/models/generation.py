@@ -1,0 +1,161 @@
+"""Autoregressive generation with a static KV cache.
+
+reference parity: the decoding surface the reference ecosystem exposes
+over these models (greedy / top-k / top-p sampling with growing KV
+caches; beam search lives in nn.BeamSearchDecoder). The reference's
+dygraph caches grow by concat each step
+(nn/layer/transformer.py MultiHeadAttention.Cache, gen_cache).
+
+TPU-native redesign: generation compiles to exactly TWO XLA programs —
+a prefill (prompt forward writing K/V into preallocated
+[B, prompt+max_new, H, D] buffers) and ONE `lax.scan` over the decode
+steps (single-token forward via dynamic_update_slice at `pos`, masked
+attention over the static buffers). No per-step retrace, no growing
+shapes, no host round-trips inside the loop.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import trace_rng
+from ..core.tensor import Tensor, no_grad
+from ..jit.functional import bind, buffer_arrays, param_arrays
+
+__all__ = ["generate"]
+
+# per-model cache of compiled generate programs, keyed by every static
+# configuration that changes the traced computation — repeat calls with
+# the same shapes/strategy hit the jit cache instead of recompiling
+_COMPILED = weakref.WeakKeyDictionary()
+
+
+def _sample(logits, key, decode_strategy, temperature, top_k, top_p):
+    """Next-token choice from [B, V] logits."""
+    if decode_strategy == "greedy_search":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    V = lg.shape[-1]
+    if top_k and 0 < top_k < V:
+        kth = jnp.sort(lg, axis=-1)[:, V - top_k][:, None]
+        lg = jnp.where(lg < kth, -1e30, lg)
+    if top_p < 1.0:
+        sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_lg, cutoff_idx[:, None],
+                                     axis=-1)
+        lg = jnp.where(lg < cutoff, -1e30, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             decode_strategy: str = "sampling", temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None,
+             pad_token_id: int = 0, seed: int = 0):
+    """Generate continuations for a batch of prompts.
+
+    model: a GPT-style Layer whose forward accepts
+    ``(input_ids, caches=<list of StaticCache>, cache_pos=<scalar>)`` and
+    returns ``(logits, caches)``.
+    input_ids: [B, S0] int array/Tensor (fixed-shape prompts).
+    Returns ids [B, S0 + max_new_tokens] (int32); positions after an
+    eos are filled with ``pad_token_id``.
+    """
+    from .gpt import GPTAttention
+
+    raw = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    raw = raw.astype(jnp.int32)
+    B, S0 = raw.shape
+    L = S0 + int(max_new_tokens)
+    cfg = model.cfg
+    if L > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) = {L} "
+            f"exceeds max_position_embeddings="
+            f"{cfg.max_position_embeddings}; positions past the table "
+            "would silently clamp")
+    H, D = cfg.num_heads, cfg.head_dim
+    was_training = model.training
+    model.eval()
+    params = param_arrays(model)
+    buffers = buffer_arrays(model)
+
+    def fresh_caches():
+        return [GPTAttention.StaticCache(
+            jnp.zeros((B, L, H, D), jnp.float32),
+            jnp.zeros((B, L, H, D), jnp.float32))
+            for _ in range(cfg.num_layers)]
+
+    def fwd(p, ids, caches, pos):
+        with bind(model, p, dict(buffers)), no_grad(), \
+                trace_rng(jax.random.key(0)):
+            logits, new_caches = model(
+                Tensor(ids),
+                caches=[GPTAttention.StaticCache(Tensor(c.k), Tensor(c.v))
+                        for c in caches],
+                cache_pos=Tensor(pos))
+        return (logits._data,
+                [GPTAttention.StaticCache(c.k._data, c.v._data)
+                 for c in new_caches])
+
+    cache_key = (B, S0, int(max_new_tokens), decode_strategy,
+                 float(temperature), int(top_k), float(top_p),
+                 eos_token_id, pad_token_id)
+    compiled = _COMPILED.setdefault(model, {})
+    run = compiled.get(cache_key)
+    if run is not None:
+        try:
+            out = run(params, raw, jax.random.key(seed))
+        finally:
+            if was_training:
+                model.train()
+        return Tensor(out)
+
+    @jax.jit
+    def run(p, prompt, key):
+        caches = fresh_caches()
+        zero = jnp.asarray(0, jnp.int32)
+        logits, caches = fwd(p, prompt, caches, zero)
+        last = logits[:, -1, :]
+        key, sub = jax.random.split(key)
+        tok = _sample(last, sub, decode_strategy, temperature, top_k,
+                      top_p)
+        finished = jnp.zeros((B,), bool) if eos_token_id is None else \
+            (tok == eos_token_id)
+
+        def step(carry, key_t):
+            caches, tok, pos, finished = carry
+            logits, caches = fwd(p, tok[:, None], caches, pos)
+            nxt = _sample(logits[:, -1, :], key_t, decode_strategy,
+                          temperature, top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, pad_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            return (caches, nxt, pos + 1, finished), nxt
+
+        if max_new_tokens == 1:
+            return jnp.concatenate([prompt, tok[:, None]], axis=1)
+        keys = jax.random.split(key, max_new_tokens - 1)
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (caches, tok, jnp.asarray(S0, jnp.int32), finished),
+            keys)
+        return jnp.concatenate([prompt, tok[:, None],
+                                jnp.moveaxis(toks, 0, 1)], axis=1)
+
+    compiled[cache_key] = run
+    try:
+        out = run(params, raw, jax.random.key(seed))
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out)

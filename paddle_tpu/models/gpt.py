@@ -30,6 +30,7 @@ TPU-native design (GSPMD, single logical program):
 
 from __future__ import annotations
 
+import collections
 import math
 from dataclasses import dataclass
 from typing import Optional
@@ -126,7 +127,13 @@ class GPTAttention(Layer):
         self.out_bias = self.create_parameter((E,), is_bias=True)
         self.out_bias.spec = P()
 
-    def forward(self, x, cache=None):
+    #: fixed-size KV buffers [B, L_max, H, D] for jit-compatible decoding
+    #: (reference generation uses growing concat caches; on TPU a static
+    #: buffer + dynamic_update_slice keeps every decode step the same
+    #: compiled program)
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def forward(self, x, cache=None, pos=None):
         cfg = self.cfg
         prec = matmul_precision()
 
@@ -139,16 +146,41 @@ class GPTAttention(Layer):
         from ..tensor.manipulation import split as tsplit, squeeze
         q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
 
-        if cache is not None:
-            from ..tensor.manipulation import concat
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
-            cache = (k, v)
+        if isinstance(cache, GPTAttention.StaticCache):
+            # write this chunk's K/V into the preallocated buffers at pos
+            def upd(buf, new, p):
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype),
+                    (0, p.astype(jnp.int32), 0, 0))
 
-        from ..ops.attention import scaled_dot_product_attention
-        out = scaled_dot_product_attention(
-            q, k, v, dropout_p=cfg.attention_dropout_prob,
-            is_causal=True, training=self.training)   # [B, S, H, D]
+            kb = apply(upd, cache.k, k, pos, name="kv_cache_update")
+            vb = apply(upd, cache.v, v, pos, name="kv_cache_update")
+            cache = GPTAttention.StaticCache(kb, vb)
+            S = x.shape[1]
+            L = kb.shape[1]
+
+            # row i of the chunk sees cache slots j <= pos + i
+            def mk_mask(p):
+                rows = p + jnp.arange(S, dtype=jnp.int32)[:, None]
+                cols = jnp.arange(L, dtype=jnp.int32)[None, :]
+                return jnp.where(cols <= rows, 0.0, -1e30)[None, None]
+
+            mask = apply(mk_mask, pos, name="kv_cache_mask")
+            from ..ops.attention import scaled_dot_product_attention
+            out = scaled_dot_product_attention(
+                q, kb, vb, attn_mask=mask, dropout_p=0.0, is_causal=False,
+                training=False)
+        else:
+            if cache is not None:
+                from ..tensor.manipulation import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+                cache = (k, v)
+
+            from ..ops.attention import scaled_dot_product_attention
+            out = scaled_dot_product_attention(
+                q, k, v, dropout_p=cfg.attention_dropout_prob,
+                is_causal=True, training=self.training)   # [B, S, H, D]
         out = _constrain(out, BATCH, None, MP, None)
 
         def out_fn(o, w, b):
@@ -201,12 +233,12 @@ class GPTDecoderLayer(Layer):
         self.dropout1 = Dropout(cfg.hidden_dropout_prob)
         self.dropout2 = Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, pos=None):
         sp = _seq_spec(self.cfg)
         if cache is None:
             a = self.attn(self.ln1(x))
         else:
-            a, cache = self.attn(self.ln1(x), cache)
+            a, cache = self.attn(self.ln1(x), cache, pos=pos)
         x = x + self.dropout1(a)
         if sp:
             x = _constrain(x, BATCH, sp, None)
@@ -237,12 +269,16 @@ class GPTModel(Layer):
                                  for _ in range(cfg.num_layers)])
         self.final_norm = LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids, position_ids=None, caches=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_pos=None):
         B, S = input_ids.shape
         if position_ids is None:
             from ..tensor.creation import arange
-            start = 0 if caches is None else caches[0][0].shape[1]
-            position_ids = arange(start, start + S, dtype="int32")
+            if cache_pos is not None:
+                position_ids = cache_pos + arange(0, S, dtype="int32")
+            else:
+                start = 0 if caches is None else caches[0][0].shape[1]
+                position_ids = arange(start, start + S, dtype="int32")
         x = self.word_embeddings(input_ids) + \
             self.position_embeddings(position_ids)
         x = self.embedding_dropout(x)
@@ -250,10 +286,16 @@ class GPTModel(Layer):
         if sp:
             x = _constrain(x, BATCH, sp, None)
 
+        if caches is not None and cache_pos is None and \
+                isinstance(caches[0], GPTAttention.StaticCache):
+            raise ValueError(
+                "StaticCache decoding needs cache_pos (the write offset "
+                "into the fixed-size KV buffers); models/generation.py "
+                "threads it automatically")
         new_caches = [] if caches is not None else None
         for i, blk in enumerate(self.layers):
             if caches is not None:
-                x, c = blk(x, caches[i])
+                x, c = blk(x, caches[i], pos=cache_pos)
                 new_caches.append(c)
             elif self.cfg.use_recompute and self.training:
                 from ..distributed.fleet.utils import recompute
@@ -312,13 +354,21 @@ class GPTForPretraining(Layer):
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
 
-    def forward(self, input_ids, position_ids=None, caches=None):
-        out = self.gpt(input_ids, position_ids, caches)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_pos=None):
+        out = self.gpt(input_ids, position_ids, caches, cache_pos=cache_pos)
         if caches is not None:
             hidden, new_caches = out
             return parallel_logits(hidden, self.gpt.word_embeddings.weight), \
                 new_caches
         return parallel_logits(out, self.gpt.word_embeddings.weight)
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """Autoregressive decoding with a static KV cache (see
+        models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        **kwargs)
 
 
 def gpt_tiny(**kw) -> GPTConfig:
