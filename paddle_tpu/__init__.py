@@ -98,6 +98,12 @@ from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
 from . import slim  # noqa: F401
+from . import device  # noqa: F401
+from . import reader  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import compat  # noqa: F401
+from . import callbacks  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

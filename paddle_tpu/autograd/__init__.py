@@ -1,3 +1,3 @@
-from .functional import grad  # noqa: F401
+from .functional import grad, hessian, jacobian, jvp, vjp  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from ..core.tensor import backward, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401

@@ -40,3 +40,105 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         results.append(g)
         t.grad = old
     return results
+
+
+# ---------------------------------------------------------------------------
+# Functional transforms (reference: python/paddle/autograd/functional.py —
+# vjp/jvp/jacobian/hessian over executed functions).
+# TPU-native: these lower straight onto jax's transforms (jacrev/jacfwd /
+# jax.vjp/jvp) — the function is re-run under tracing with the leaf
+# tensors as pure inputs, so the result is itself jit-compatible.
+# ---------------------------------------------------------------------------
+
+
+def _pure(func):
+    """Wrap a Tensor-world callable as a pure array function."""
+    def fn(*arrays):
+        from ..core.tensor import no_grad
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+    return fn
+
+
+def _raw_list(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in xs]
+
+
+def _wrap_tree(tree):
+    import jax
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) (reference: autograd/functional.py vjp)."""
+    import jax
+    raw = _raw_list(xs)
+    single_input = not isinstance(xs, (list, tuple))
+    out, vjp_fn = jax.vjp(_pure(func), *raw)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = tuple(_raw_list(v)) if isinstance(out, tuple) else \
+            _raw_list([v])[0] if not isinstance(v, (list, tuple)) else \
+            _raw_list(v)[0]
+    grads = [Tensor(g) for g in vjp_fn(cot)]
+    outs = _wrap_tree(out)
+    # mirror the INPUT structure (like jacobian): list in -> list out
+    return outs, grads[0] if single_input else grads
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — forward-mode (reference: functional.jvp)."""
+    import jax
+    raw = _raw_list(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in raw)
+    else:
+        tangents = tuple(_raw_list(v))
+    out, tangent_out = jax.jvp(_pure(func), tuple(raw), tangents)
+    return _wrap_tree(out), _wrap_tree(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Jacobian of func at xs (reference: functional.jacobian).
+
+    Single input -> Tensor [*out_shape, *in_shape]; multiple inputs ->
+    tuple of Jacobians, one per input."""
+    import jax
+    raw = _raw_list(xs)
+    single = not isinstance(xs, (list, tuple))
+    jac = jax.jacrev(_pure(func), argnums=tuple(range(len(raw))))(*raw)
+    jac = _wrap_tree(jac)
+    if single:
+        return jac[0] if isinstance(jac, (list, tuple)) else jac
+    return jac
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Hessian of a scalar-output func (reference: functional.hessian)."""
+    import jax
+    raw = _raw_list(xs)
+    single = not isinstance(xs, (list, tuple))
+
+    def scalar(*arrays):
+        out = _pure(func)(*arrays)
+        out = out[0] if isinstance(out, tuple) else out
+        if out.ndim != 0:
+            raise ValueError("hessian needs a scalar-output function, got "
+                             f"output shape {out.shape}")
+        return out
+
+    hess = jax.hessian(scalar, argnums=tuple(range(len(raw))))(*raw)
+    hess = _wrap_tree(hess)
+    if single:
+        h = hess
+        while isinstance(h, (list, tuple)):
+            h = h[0]
+        return h
+    return hess

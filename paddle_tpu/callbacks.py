@@ -1,0 +1,5 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py
+re-exporting the hapi callback family)."""
+
+from .hapi.callbacks import *  # noqa: F401,F403
+from .hapi.callbacks import __all__  # noqa: F401

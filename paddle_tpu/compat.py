@@ -1,0 +1,30 @@
+"""paddle.compat string helpers (reference: python/paddle/compat.py)."""
+
+__all__ = ["to_text", "to_bytes", "long_type", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, (list, set)):
+        return type(obj)(to_text(o, encoding) for o in obj)
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj) if not isinstance(obj, str) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, (list, set)):
+        return type(obj)(to_bytes(o, encoding) for o in obj)
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return bytes(obj) if not isinstance(obj, bytes) else obj
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

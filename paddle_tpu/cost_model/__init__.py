@@ -1,0 +1,46 @@
+"""Cost model (reference: python/paddle/cost_model/cost_model.py —
+profile_measure running a program under the profiler to collect op
+costs).
+
+TPU-native: XLA's cost analysis gives static FLOP/byte counts for the
+compiled program and a timed run gives wall cost; both come from the
+same jitted callable a user would train with."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Sequence
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def profile_measure(self, fn, args: Sequence = (), iters: int = 10,
+                        warmup: int = 2) -> Dict[str, float]:
+        """Measure a callable over example args.
+
+        Returns {'flops', 'bytes_accessed', 'wall_ms', 'achieved_tflops'}.
+        """
+        import jax
+
+        raw = [a._data if hasattr(a, "_data") else a for a in args]
+        jitted = jax.jit(lambda *xs: fn(*xs))
+        lowered = jitted.lower(*raw)
+        analysis = lowered.cost_analysis() or {}
+        out = jitted(*raw)
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            out = jitted(*raw)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jitted(*raw)
+        jax.block_until_ready(out)
+        wall = (time.perf_counter() - t0) / iters
+        flops = float(analysis.get("flops", 0.0))
+        return {
+            "flops": flops,
+            "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+            "wall_ms": wall * 1e3,
+            "achieved_tflops": flops / wall / 1e12 if wall > 0 else 0.0,
+        }

@@ -1,0 +1,71 @@
+"""paddle.device namespace (reference: python/paddle/device.py —
+set_device/get_device/is_compiled_with_* plus the cuda sub-namespace).
+
+TPU-native: devices resolve through jax; CUDA-named entry points map to
+the accelerator so reference scripts run unchanged."""
+
+from __future__ import annotations
+
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, Place, TPUPlace,
+    XPUPlace, device_count, get_device, is_compiled_with_cuda,
+    is_compiled_with_tpu, set_device)
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_all_custom_device_type", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cuda",
+           "is_compiled_with_tpu", "cuda"]
+
+
+def get_all_device_type():
+    import jax
+    kinds = {d.platform for d in jax.devices()}
+    return sorted(kinds | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+class cuda:
+    """paddle.device.cuda shims: 'cuda' means the attached accelerator."""
+
+    @staticmethod
+    def device_count() -> int:
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        pass          # XLA owns HBM; nothing to release eagerly
+
+    @staticmethod
+    def max_memory_allocated(device=None) -> int:
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            return int(stats.get("peak_bytes_in_use", 0))
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None) -> int:
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            return int(stats.get("bytes_in_use", 0))
+        except Exception:
+            return 0

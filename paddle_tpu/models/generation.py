@@ -16,7 +16,6 @@ shapes, no host round-trips inside the loop.
 
 from __future__ import annotations
 
-import weakref
 from typing import Optional
 
 import jax
@@ -28,11 +27,6 @@ from ..core.tensor import Tensor, no_grad
 from ..jit.functional import bind, buffer_arrays, param_arrays
 
 __all__ = ["generate"]
-
-# per-model cache of compiled generate programs, keyed by every static
-# configuration that changes the traced computation — repeat calls with
-# the same shapes/strategy hit the jit cache instead of recompiling
-_COMPILED = weakref.WeakKeyDictionary()
 
 
 def _sample(logits, key, decode_strategy, temperature, top_k, top_p):
@@ -72,6 +66,11 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     """
     from .gpt import GPTAttention
 
+    if decode_strategy not in ("greedy_search", "sampling"):
+        raise ValueError(
+            f"unknown decode_strategy {decode_strategy!r}: use "
+            "'greedy_search' or 'sampling' (beam search lives in "
+            "paddle.nn.BeamSearchDecoder + dynamic_decode)")
     raw = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
     raw = raw.astype(jnp.int32)
@@ -111,7 +110,13 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     cache_key = (B, S0, int(max_new_tokens), decode_strategy,
                  float(temperature), int(top_k), float(top_p),
                  eos_token_id, pad_token_id)
-    compiled = _COMPILED.setdefault(model, {})
+    # compiled programs live ON the model (a closure over the model stored
+    # in any global map would pin the model alive; an attribute is just a
+    # collectible reference cycle)
+    compiled = getattr(model, "_gen_compiled", None)
+    if compiled is None:
+        compiled = {}
+        object.__setattr__(model, "_gen_compiled", compiled)
     run = compiled.get(cache_key)
     if run is not None:
         try:

@@ -1,0 +1,120 @@
+"""Legacy reader decorators (reference: python/paddle/reader/decorator.py:
+map_readers, shuffle, chain, compose, buffered, firstn, cache,
+xmap_readers). Pure-python composition utilities over sample generators;
+kept for migrating reference data pipelines (new code: paddle.io)."""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "cache", "xmap_readers"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        yield from itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        rs = [r() for r in readers]
+        for parts in (zip(*rs) if check_alignment
+                      else itertools.zip_longest(*rs)):
+            yield sum((make_tuple(p) for p in parts), ())
+    return composed
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples."""
+    end = object()
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool map over a reader (reference keeps sample order only
+    when order=True)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def xreader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            it = reader()
+            if order:
+                yield from pool.map(mapper, it)
+            else:
+                futures = []
+                for sample in it:
+                    futures.append(pool.submit(mapper, sample))
+                    if len(futures) >= buffer_size:
+                        done = futures.pop(0)
+                        yield done.result()
+                for f in futures:
+                    yield f.result()
+    return xreader

@@ -1,0 +1,15 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(__file__)
+
+
+def get_include() -> str:
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib() -> str:
+    return os.path.join(_ROOT, "libs")

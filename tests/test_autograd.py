@@ -138,3 +138,34 @@ def test_retain_grads_intermediate():
     z = y * 3
     z.backward()
     np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_functional_transforms_jacobian_hessian_vjp_jvp():
+    """reference: python/paddle/autograd/functional.py."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(a):
+        return (a ** 3).sum()
+
+    h = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.diag(6 * np.array([1., 2., 3.])),
+                               atol=1e-5)
+    j = paddle.autograd.jacobian(lambda a: a ** 2, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2., 4., 6.]), atol=1e-5)
+    out, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([1., 4., 9.]),
+                               atol=1e-5)
+    _, t = paddle.autograd.jvp(
+        lambda a: a * a, x,
+        paddle.to_tensor(np.array([0., 1., 0.], np.float32)))
+    np.testing.assert_allclose(t.numpy(), [0., 4., 0.], atol=1e-5)
+    # multi-input jacobian returns one per input
+    def g2(a, b):
+        return a * b
+    ja, jb = paddle.autograd.jacobian(
+        g2, [x, paddle.to_tensor(np.array([2., 2., 2.], np.float32))])
+    np.testing.assert_allclose(ja.numpy(), np.diag([2., 2., 2.]), atol=1e-5)
+    np.testing.assert_allclose(jb.numpy(), np.diag([1., 2., 3.]), atol=1e-5)

@@ -1,0 +1,52 @@
+"""Small namespace modules: device, reader, cost_model, sysconfig,
+compat, callbacks, autograd functional transforms."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_device_namespace():
+    assert isinstance(paddle.device.get_device(), str)
+    assert "cpu" in paddle.device.get_all_device_type()
+    assert paddle.device.cuda.device_count() >= 1
+    paddle.device.cuda.synchronize()
+    assert paddle.device.cuda.memory_allocated() >= 0
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert list(paddle.reader.chain(r, r)()) == list(range(10)) * 2
+    assert sorted(paddle.reader.shuffle(r, 5)()) == list(range(10))
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    assert list(paddle.reader.buffered(r, 4)()) == list(range(10))
+    c = paddle.reader.cache(r)
+    assert list(c()) == list(range(10)) and list(c()) == list(range(10))
+    assert list(paddle.reader.compose(r, r)()) == \
+        [(i, i) for i in range(10)]
+    out = list(paddle.reader.xmap_readers(lambda x: x * 3, r, 2, 4,
+                                          order=True)())
+    assert out == [3 * i for i in range(10)]
+
+
+def test_cost_model_measures_matmul():
+    import jax.numpy as jnp
+    cm = paddle.cost_model.CostModel()
+    a = np.ones((128, 128), np.float32)
+    res = cm.profile_measure(lambda x: jnp.matmul(x, x), [a], iters=3)
+    assert res["flops"] >= 2 * 128 ** 3 * 0.9
+    assert res["wall_ms"] > 0
+
+
+def test_compat_and_sysconfig():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert isinstance(paddle.sysconfig.get_include(), str)
+
+
+def test_callbacks_namespace():
+    assert hasattr(paddle.callbacks, "ModelCheckpoint")
+    assert hasattr(paddle.callbacks, "EarlyStopping")
