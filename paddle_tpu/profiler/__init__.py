@@ -18,11 +18,23 @@ from typing import Optional
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "profiler", "start_profiler",
-           "stop_profiler", "summary", "profile_train_step"]
+           "stop_profiler", "summary", "profile_train_step",
+           "export_chrome_tracing"]
 
 _tls = threading.local()
 _events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_sec]
 _active = [False]
+# host timeline: (name, t_start_us, dur_us, thread_id); bounded so a long
+# run cannot grow without limit (the chrome trace keeps the newest events)
+_TIMELINE_CAP = 200_000
+_timeline = []
+
+
+def _timeline_add(name: str, t0: float, t1: float):
+    if len(_timeline) >= _TIMELINE_CAP:
+        del _timeline[: _TIMELINE_CAP // 2]
+    _timeline.append((name, t0 * 1e6, (t1 - t0) * 1e6,
+                      threading.get_ident()))
 
 
 class RecordEvent:
@@ -46,9 +58,11 @@ class RecordEvent:
         if self._ann is not None:
             self._ann.__exit__(*exc)
         if _active[0]:
+            t1 = time.perf_counter()
             rec = _events[self.name]
             rec[0] += 1
-            rec[1] += time.perf_counter() - self.t0
+            rec[1] += t1 - self.t0
+            _timeline_add(self.name, self.t0, t1)
         return False
 
 
@@ -56,6 +70,8 @@ def _op_hook(name: str, seconds: float):
     rec = _events["op::" + name]
     rec[0] += 1
     rec[1] += seconds
+    t1 = time.perf_counter()
+    _timeline_add("op::" + name, t1 - seconds, t1)
 
 
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
@@ -73,6 +89,7 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     """
     _active[0] = True
     _events.clear()
+    _timeline.clear()
     from ..core.tensor import set_op_profile_hook
     set_op_profile_hook(_op_hook)
     if log_dir:
@@ -96,6 +113,24 @@ def summary(sorted_by="total"):
         lines.append(f"{name:<40} {count:>8} {total * 1e3:>12.3f} "
                      f"{total * 1e3 / max(count, 1):>12.3f}")
     return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str) -> str:
+    """Write the host-side event timeline as a chrome trace
+    (chrome://tracing / Perfetto JSON; the reference emits its
+    profiler.proto timeline the same way, device_tracer.cc GenProfile:496).
+    Device-side kernels live in the XPlane trace captured via
+    ``start_profiler(log_dir=...)``; this file covers the host lanes
+    (RecordEvent blocks + eager op dispatches)."""
+    import json
+
+    events = [{"name": name, "ph": "X", "ts": ts, "dur": dur,
+               "pid": 0, "tid": tid % 100000, "cat": "host"}
+              for name, ts, dur, tid in _timeline]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 @contextlib.contextmanager

@@ -202,3 +202,26 @@ def test_profile_train_step_breakdown():
                        "device_ms_est"}
     assert br["compile_s"] > 0 and br["step_ms"] > 0
     assert br["device_ms_est"] >= 0
+
+
+def test_profiler_chrome_trace_export(tmp_path):
+    """reference: platform/device_tracer.cc GenProfile chrome timeline."""
+    import json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler as prof
+
+    prof.start_profiler()
+    with prof.RecordEvent("outer_block"):
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        (x * x).sum().numpy()
+    prof.stop_profiler()
+    path = prof.export_chrome_tracing(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "outer_block" in names
+    assert any(n.startswith("op::") for n in names)
+    for e in data["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
