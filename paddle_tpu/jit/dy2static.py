@@ -1,0 +1,278 @@
+"""Dygraph-to-static AST transform: python `if`/`while` over tensors.
+
+reference parity: the dygraph_to_static AST translator
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:768, ifelse_transformer.py IfElseTransformer,
+loop_transformer.py LoopTransformer) which rewrites python control flow
+into conditional_block/while ops.
+
+TPU-native redesign: the transform functionalizes each `if`/`while`
+into a call to a dispatch helper — `__jst_if__` / `__jst_while__` —
+passing the variables either branch assigns as explicit arguments
+(parameters shadow the outer names, so branch bodies run unchanged).
+At RUNTIME the helper checks the condition's type: a concrete python
+bool takes the normal python path (zero overhead, exact semantics);
+a traced/eager Tensor routes to `static.nn.cond` / `while_loop`
+(lax.cond / lax.while_loop), which is the XLA-compilable form.
+
+Deliberately restricted (falls back to the untransformed statement,
+where tracing's guided ConcretizationTypeError explains the options):
+- branches containing return / break / continue / yield
+- variables created in only one branch and never defined before the if
+  (both branches must produce every output)
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, Optional
+
+__all__ = ["ast_transform", "convert_to_static"]
+
+class _Unbound:
+    """Placeholder for a name with no binding before the control flow.
+    Harmless to carry and reassign; USING it raises a clear NameError
+    (mirroring python's unbound-local behavior)."""
+
+    def __repr__(self):
+        return "<unbound dy2static variable>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "variable was only assigned inside control flow that did not "
+            "execute; initialize it before the if/while")
+
+    __bool__ = __getattr__ = __call__ = __add__ = __radd__ = __sub__ = \
+        __mul__ = __iter__ = __len__ = __float__ = __int__ = _raise
+
+
+# single sentinel instance shared by all transformed functions
+_UNDEF = _Unbound()
+
+
+def _assigned_names(nodes) -> set:
+    out = set()
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                out.add(sub.id)
+            elif isinstance(sub, ast.AugAssign) and \
+                    isinstance(sub.target, ast.Name):
+                out.add(sub.target.id)
+    return out
+
+
+def _has_scope_decl(nodes) -> bool:
+    return any(isinstance(sub, (ast.Global, ast.Nonlocal))
+               for n in nodes for sub in ast.walk(n))
+
+
+def _has_flow_escape(nodes) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True
+            if isinstance(sub, (ast.Break, ast.Continue)):
+                # only count break/continue that would escape THIS block
+                # (ones inside a nested loop are fine) — conservative:
+                # treat any as escaping
+                return True
+    return False
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _fn_def(name, args, body):
+    fd = ast.FunctionDef(name=name, args=args, body=body,
+                         decorator_list=[], returns=None)
+    fd.type_params = []          # py3.12+ field
+    return fd
+
+
+def _undef_guard(name):
+    """`try: name\nexcept NameError: name = __jst_undef__` — lets
+    `if c: y = a else: y = b` work when y has no prior binding."""
+    return ast.Try(
+        body=[ast.Expr(value=_load(name))],
+        handlers=[ast.ExceptHandler(
+            type=_load("NameError"), name=None,
+            body=[ast.Assign(targets=[_store(name)],
+                             value=_load("__jst_undef__"))])],
+        orelse=[], finalbody=[])
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _next(self, kind):
+        self._counter += 1
+        return f"__jst_{kind}_{self._counter}"
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node
+        body_names = {m for m in _assigned_names(node.body)
+                      if not m.startswith("__jst_")}
+        else_names = {m for m in _assigned_names(node.orelse)
+                      if not m.startswith("__jst_")}
+        if body_names != else_names:
+            # a name produced by only one branch cannot be functionalized
+            # (lax.cond branches must return identical structures); leave
+            # the python `if` intact — eager semantics are exact, and
+            # tracing raises the guided concretization error
+            return node
+        if _has_scope_decl(node.body) or _has_scope_decl(node.orelse):
+            return node                  # global/nonlocal in a branch
+        mod = sorted(body_names)
+        name_t = self._next("true")
+        name_f = self._next("false")
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg=m)
+                                                   for m in mod],
+                             kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(elts=[_load(m) for m in mod],
+                                         ctx=ast.Load()))
+        tbody = (node.body or [ast.Pass()]) + [ret]
+        fbody = (node.orelse or [ast.Pass()]) + [ret]
+        fn_t = _fn_def(name_t, args, tbody)
+        fn_f = _fn_def(name_f, args, fbody)
+        call = ast.Call(func=_load("__jst_if__"),
+                        args=[node.test, _load(name_t), _load(name_f),
+                              ast.Tuple(elts=[_load(m) for m in mod],
+                                        ctx=ast.Load()),
+                              ast.Constant(value=tuple(mod))],
+                        keywords=[])
+        if mod:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_store(m) for m in mod],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [_undef_guard(m) for m in mod] + [fn_t, fn_f, assign]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        mod = sorted(m for m in _assigned_names(node.body)
+                     if not m.startswith("__jst_"))
+        if not mod or _has_scope_decl(node.body):
+            return node
+        name_c = self._next("cond")
+        name_b = self._next("body")
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg=m)
+                                                   for m in mod],
+                             kwonlyargs=[], kw_defaults=[], defaults=[])
+        fn_c = _fn_def(name_c, args, [ast.Return(value=node.test)])
+        fn_b = _fn_def(name_b, args,
+                       list(node.body) + [ast.Return(value=ast.Tuple(
+                           elts=[_load(m) for m in mod], ctx=ast.Load()))])
+        call = ast.Call(func=_load("__jst_while__"),
+                        args=[_load(name_c), _load(name_b),
+                              ast.Tuple(elts=[_load(m) for m in mod],
+                                        ctx=ast.Load()),
+                              ast.Constant(value=tuple(mod))],
+                        keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(m) for m in mod],
+                               ctx=ast.Store())],
+            value=call)
+        return [_undef_guard(m) for m in mod] + [fn_c, fn_b, assign]
+
+
+def __jst_if__(test, true_fn, false_fn, vals, names):
+    from ..core.tensor import Tensor, _is_tracer
+    raw = test._data if isinstance(test, Tensor) else test
+    # ONLY tracers take the functional branch: an eager concrete Tensor
+    # keeps exact python semantics (one branch runs, side effects intact)
+    if _is_tracer(raw):
+        from ..static import nn as snn
+        # names with no prior binding carry the sentinel; both branches
+        # assign them (they never read the incoming value), so hand the
+        # tracer a benign zero instead of a non-JAX object
+        vals = tuple(0 if v is _UNDEF else v for v in vals)
+        return snn.cond(test, true_fn, false_fn, *vals)
+    return true_fn(*vals) if test else false_fn(*vals)
+
+
+def __jst_while__(cond_fn, body_fn, vals, names):
+    from ..core.tensor import Tensor, _is_tracer
+    undef = [n for n, v in zip(names, vals) if v is _UNDEF]
+    first = cond_fn(*vals)
+    raw = first._data if isinstance(first, Tensor) else first
+    if _is_tracer(raw):
+        if undef:
+            raise NameError(
+                f"loop variable(s) {undef} are assigned inside a "
+                "tensor-dependent while but have no value before it; "
+                "lax.while_loop carries need an initial binding — "
+                "initialize them before the loop")
+        from ..static import nn as snn
+        out = snn.while_loop(cond_fn, body_fn, list(vals))
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+    while bool(first):
+        vals = body_fn(*vals)
+        first = cond_fn(*vals)
+    # after a zero-iteration loop, inside-only names stay the _Unbound
+    # sentinel: carrying/reassigning it is fine, USING it raises a clear
+    # NameError (python's unbound-local contract)
+    return tuple(vals)
+
+
+def ast_transform(func: Callable) -> Optional[Callable]:
+    """Return a control-flow-functionalized version of `func`, or None if
+    the function cannot be transformed (no source, closures, lambdas)."""
+    try:
+        if func.__closure__:
+            return None                  # cell vars can't be recompiled
+        src = textwrap.dedent(inspect.getsource(func))
+    except (OSError, TypeError, AttributeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fn_def = tree.body[0]
+    if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fn_def.decorator_list = []           # avoid re-applying @to_static
+    try:
+        new_tree = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new_tree)
+        # execute against the function's LIVE module globals so late-bound
+        # helpers, recursion and mutated module state keep working; the
+        # dispatch helpers ride prefixed names that cannot clash
+        globs = func.__globals__
+        globs.setdefault("__jst_if__", __jst_if__)
+        globs.setdefault("__jst_while__", __jst_while__)
+        globs.setdefault("__jst_undef__", _UNDEF)
+        code = compile(new_tree,
+                       filename=f"<dy2static {func.__qualname__}>",
+                       mode="exec")
+        ns: dict = {}
+        exec(code, globs, ns)
+        new_fn = ns[fn_def.name]
+    except Exception:
+        return None                      # degrade to the original function
+    new_fn.__defaults__ = func.__defaults__
+    new_fn.__kwdefaults__ = func.__kwdefaults__
+    return functools.wraps(func)(new_fn)
+
+
+def convert_to_static(func: Callable) -> Callable:
+    """Transform, falling back to the original on any limitation."""
+    out = ast_transform(func)
+    return out if out is not None else func

@@ -213,13 +213,21 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """Decorator/wrapper compiling a Layer or function."""
 
     def _decorate(obj):
+        from .dy2static import convert_to_static
         if isinstance(obj, Layer):
-            static = StaticFunction(type(obj).forward.__get__(obj), layer=obj,
-                                    input_spec=input_spec)
+            fwd = convert_to_static(type(obj).forward).__get__(obj)
+            static = StaticFunction(fwd, layer=obj, input_spec=input_spec)
             obj.forward = static
             return obj
-        # plain function or unbound Layer.forward
-        return StaticFunction(obj, layer=getattr(obj, "__self__", None),
+        # plain function or unbound Layer.forward; python if/while over
+        # tensors is functionalized by the dy2static AST pass (reference:
+        # program_translator.py ProgramTranslator)
+        fn = getattr(obj, "__func__", obj)
+        bound = getattr(obj, "__self__", None)
+        converted = convert_to_static(fn)
+        if bound is not None:
+            converted = converted.__get__(bound)
+        return StaticFunction(converted, layer=bound,
                               input_spec=input_spec)
 
     if function is not None:
