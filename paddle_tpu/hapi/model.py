@@ -54,6 +54,18 @@ class Model:
         return self
 
     # ------------------------------------------------------------------
+    def forward(self, *inputs):
+        """Delegate to the wrapped network (reference: Model.forward)."""
+        return self.network(*inputs)
+
+    @property
+    def mode(self):
+        return "train" if self.network.training else "eval"
+
+    @mode.setter
+    def mode(self, value):
+        self.network.train() if value == "train" else self.network.eval()
+
     def train_batch(self, inputs, labels=None, update=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]

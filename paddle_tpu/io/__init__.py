@@ -18,4 +18,4 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler,
     WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import WorkerInfo, get_worker_info, DataLoader, default_collate_fn  # noqa: F401

@@ -44,9 +44,31 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
+class WorkerInfo:
+    """reference: dataloader/worker.py WorkerInfo / paddle.io.get_worker_info:
+    identifies the current DataLoader worker inside dataset code (e.g. to
+    shard an IterableDataset across workers)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """The WorkerInfo of the calling worker process, or None in the main
+    process (reference: paddle.io.get_worker_info)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_init_fn,
-                 worker_id):
+                 worker_id, num_workers=0):
     """reference: dataloader/worker.py:257 _worker_loop."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -62,12 +84,14 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_init_fn,
 
 
 def _worker_loop_pipe(dataset, index_queue, conn, collate_fn, worker_init_fn,
-                      worker_id):
+                      worker_id, num_workers=0):
     """Worker for the native-queue transport: batches leave as RAW pickled
     frames over a dedicated pipe, so the consumer side deserializes exactly
     once (reference: worker.py:341 shared-memory handoff — here the bytes
     land in the C++ blocking queue instead of an mmap segment)."""
     import pickle
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -148,7 +172,8 @@ class _MultiprocessIter:
             w = ctx.Process(
                 target=target,
                 args=(loader.dataset, self.index_queue, sink,
-                      loader.collate_fn, loader.worker_init_fn, wid),
+                      loader.collate_fn, loader.worker_init_fn, wid,
+                      loader.num_workers),
                 daemon=True)
             w.start()
             self.workers.append(w)

@@ -70,6 +70,16 @@ def _has_scope_decl(nodes) -> bool:
                for n in nodes for sub in ast.walk(n))
 
 
+def _has_nonname_binding(nodes) -> bool:
+    """import / def / class statements bind names invisibly to the
+    Name-store scan; functionalizing such a branch would trap the binding
+    in the generated function's locals."""
+    return any(isinstance(sub, (ast.Import, ast.ImportFrom,
+                                ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef))
+               for n in nodes for sub in ast.walk(n))
+
+
 def _has_flow_escape(nodes) -> bool:
     for n in nodes:
         for sub in ast.walk(n):
@@ -133,8 +143,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             # the python `if` intact — eager semantics are exact, and
             # tracing raises the guided concretization error
             return node
-        if _has_scope_decl(node.body) or _has_scope_decl(node.orelse):
-            return node                  # global/nonlocal in a branch
+        if _has_scope_decl(node.body) or _has_scope_decl(node.orelse) \
+                or _has_nonname_binding(node.body) \
+                or _has_nonname_binding(node.orelse):
+            return node        # global/nonlocal/import/def in a branch
         mod = sorted(body_names)
         name_t = self._next("true")
         name_f = self._next("false")
@@ -169,7 +181,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         mod = sorted(m for m in _assigned_names(node.body)
                      if not m.startswith("__jst_"))
-        if not mod or _has_scope_decl(node.body):
+        if not mod or _has_scope_decl(node.body) \
+                or _has_nonname_binding(node.body) \
+                or any(isinstance(sub, ast.NamedExpr)
+                       for sub in ast.walk(node.test)):
+            # a walrus in the condition binds a name the body reads; the
+            # binding would become local to the generated cond function
             return node
         name_c = self._next("cond")
         name_b = self._next("body")

@@ -50,3 +50,61 @@ def test_compat_and_sysconfig():
 def test_callbacks_namespace():
     assert hasattr(paddle.callbacks, "ModelCheckpoint")
     assert hasattr(paddle.callbacks, "EarlyStopping")
+
+
+def test_get_worker_info_in_workers():
+    from paddle_tpu.io import DataLoader, get_worker_info
+
+    assert get_worker_info() is None          # main process
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            info = get_worker_info()
+            assert info is not None and info.num_workers == 2
+            return np.array([i, info.id], np.int64)
+
+    dl = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False)
+    seen_workers = set()
+    for batch in dl:
+        arr = batch.numpy() if hasattr(batch, "numpy") else \
+            np.asarray(batch)
+        seen_workers.update(arr.reshape(-1, 2)[:, 1].tolist())
+    assert seen_workers <= {0, 1} and len(seen_workers) >= 1
+
+
+def test_new_vision_transforms():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.random.default_rng(0).uniform(0, 255, (3, 16, 16)) \
+        .astype(np.float32)
+    np.random.seed(0)
+    for t in [T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.Grayscale(3),
+              T.RandomVerticalFlip(1.0), T.RandomRotation(30),
+              T.BrightnessTransform(0.5), T.ContrastTransform(0.5),
+              T.SaturationTransform(0.5), T.HueTransform(0.25)]:
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+        assert np.isfinite(out).all(), type(t).__name__
+    rc = T.RandomResizedCrop(8)
+    out = rc(img)
+    assert out.shape == (3, 8, 8)
+    flipped = T.RandomVerticalFlip(1.0)(img)
+    np.testing.assert_allclose(flipped, img[:, ::-1], atol=1e-6)
+    gray = T.Grayscale(1)(img)
+    assert gray.shape == (1, 16, 16)
+
+
+def test_model_forward_and_mode():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    net = nn.Linear(4, 2)
+    m = paddle.Model(net)
+    assert m.mode == "train"
+    m.mode = "eval"
+    assert not net.training
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    assert m.forward(x).shape == [2, 2]
