@@ -104,6 +104,7 @@ from . import cost_model  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import compat  # noqa: F401
 from . import callbacks  # noqa: F401
+from . import version  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -29,7 +29,7 @@ from ..nn.layers.norm import LayerNorm
 from ..nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
 
 __all__ = ["BertConfig", "BertModel", "BertForMaskedLM", "bert_tiny",
-           "bert_base"]
+           "bert_base", "bert_large"]
 
 
 @dataclass
@@ -157,6 +157,13 @@ def bert_tiny(**kw) -> BertConfig:
     d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
              intermediate_size=128, max_position_embeddings=128,
              hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+def bert_large(**kw) -> BertConfig:
+    d = dict(hidden_size=1024, num_layers=24, num_heads=16,
+             intermediate_size=4096)
     d.update(kw)
     return BertConfig(**d)
 

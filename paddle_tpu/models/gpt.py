@@ -51,7 +51,7 @@ from ..nn.layers.common import Dropout, Embedding
 from ..nn.layers.norm import LayerNorm
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
-           "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium"]
+           "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_xl"]
 
 MP = "mp"
 SP = "sp"
@@ -383,6 +383,20 @@ def gpt_tiny(**kw) -> GPTConfig:
 def gpt2_small(**kw) -> GPTConfig:
     d = dict(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
              max_position_embeddings=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_large(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=1280, num_layers=36,
+             num_heads=20, max_position_embeddings=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_xl(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=1600, num_layers=48,
+             num_heads=25, max_position_embeddings=1024)
     d.update(kw)
     return GPTConfig(**d)
 

@@ -1,0 +1,25 @@
+"""paddle.version (reference: generated python/paddle/version.py)."""
+
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+cuda_version = "None"        # the accelerator is a TPU
+cudnn_version = "None"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
