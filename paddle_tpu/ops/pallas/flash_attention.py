@@ -792,7 +792,11 @@ def _seed_arr(seed_f):
 # vmem limit is 16M; stay well under it.
 _V2_FWD_TILE_BUDGET = 4 * 1024 * 1024
 _V2_BWD_TILE_BUDGET = 8 * 1024 * 1024
-_V2_SCRATCH_CAP = 4 * 1024 * 1024
+# the fused backward carries a full-Sq f32 dq scratch AND a full-Sq dq
+# output window; beyond this they crowd out the score tiles (measured:
+# S=8192/D=64/hp=2 overflows the 16M scoped limit), so longer sequences
+# route to the v1 split kernels, which tile everything
+_V2_SCRATCH_CAP = 2 * 1024 * 1024
 
 
 def _v2_plan(q, bias, block_q, block_k):

@@ -1,5 +1,8 @@
-"""Throwaway: attribute BERT step time by timing ablations on the chip."""
+"""Dev tool: attribute BERT step time by timing ablations on the chip."""
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import time
 
 import numpy as np
