@@ -94,3 +94,19 @@ def test_hybrid_tp_zero_on_mesh():
     losses = [float(step(*data)) for _ in range(5)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_ernie_hybrid_dryrun_on_virtual_mesh():
+    """BASELINE config 5 shape (dp x sharding x mp + AMP O1 + ZeRO Adam)
+    — the driver's dryrun_multichip config C, kept green in CI."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(__file__), "..",
+                                    "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import numpy as np
+    loss = mod._run_ernie_hybrid(8)
+    assert np.isfinite(loss)
