@@ -1475,3 +1475,75 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                           precision=matmul_precision())
     return apply(_sa, _t(query), _t(key), _t(value), _t(sparse_csr_offset),
                  _t(sparse_csr_columns), name="sparse_attention")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, o] = x1[b, i] W[o, i, j] x2[b, j] + bias (reference:
+    nn/functional/common.py bilinear over bilinear_tensor_product_op)."""
+
+    def _bl(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out + bb[0] if bb else out
+
+    args = [_t(x1), _t(x2), _t(weight)] + ([_t(bias)]
+                                           if bias is not None else [])
+    return apply(_bl, *args, name="bilinear")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """x if x > threshold else 0 (reference: activation.py
+    thresholded_relu)."""
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0).astype(a.dtype),
+                 _t(x), name="thresholded_relu")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax CE (reference:
+    operators/margin_cross_entropy_op.cu): the target logit cos(theta) is
+    replaced by cos(m1*theta + m2) - m3, everything scaled by s."""
+
+    def _mce(lg, lab):
+        lg32 = lg.astype(jnp.float32)
+        ids = lab.astype(jnp.int32).reshape(-1)
+        tgt = jnp.take_along_axis(lg32, ids[:, None], axis=-1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tgt, -1.0, 1.0))
+        tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(ids, lg32.shape[-1], dtype=lg32.dtype)
+        adj = lg32 * (1 - onehot) + tgt_m[:, None] * onehot
+        adj = adj * scale
+        lse = jax.nn.logsumexp(adj, axis=-1)
+        per = lse - jnp.take_along_axis(adj, ids[:, None], axis=-1)[:, 0]
+        sm = jax.nn.softmax(adj, axis=-1)
+        if reduction == "mean":
+            loss = jnp.mean(per)
+        elif reduction == "sum":
+            loss = jnp.sum(per)
+        else:
+            loss = per
+        return (loss, sm)
+
+    loss_sm = apply(_mce, _t(logits), _t(label), name="margin_cross_entropy")
+    if return_softmax:
+        return loss_sm
+    return loss_sm[0]
+
+
+def _make_inplace(fn_name):
+    def inplace(x, *args, **kwargs):
+        from ..tensor.tail import _rebind
+        out = globals()[fn_name](x, *args, **kwargs)
+        return _rebind(_t(x), out)
+    inplace.__name__ = fn_name + "_"
+    inplace.__doc__ = (f"Inplace variant of :func:`{fn_name}` "
+                       "(rebinds the tensor's buffer).")
+    return inplace
+
+
+relu_ = _make_inplace("relu")
+elu_ = _make_inplace("elu")
+softmax_ = _make_inplace("softmax")
+
+
+from ..tensor.tail import diag_embed  # noqa: E402,F401

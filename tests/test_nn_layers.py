@@ -256,3 +256,40 @@ def test_one_hot_and_sequence_mask():
     lens = paddle.to_tensor(np.array([1, 3]), dtype="int64")
     m = F.sequence_mask(lens, maxlen=4)
     np.testing.assert_array_equal(m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_functional_tail_bilinear_margin_ce_inplace():
+    """reference: nn/functional bilinear, margin_cross_entropy (ArcFace),
+    inplace activation variants."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x1 = paddle.to_tensor(rng.normal(size=(2, 3)).astype(np.float32))
+    x2 = paddle.to_tensor(rng.normal(size=(2, 4)).astype(np.float32))
+    w = paddle.to_tensor(rng.normal(size=(5, 3, 4)).astype(np.float32))
+    b = paddle.to_tensor(rng.normal(size=(5,)).astype(np.float32))
+    out = F.bilinear(x1, x2, w, b)
+    ref = np.einsum("bi,oij,bj->bo", x1.numpy(), w.numpy(), x2.numpy()) \
+        + b.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5, rtol=1e-5)
+
+    # margin CE with zero margins/scale-1 reduces to plain softmax CE
+    # (logits must be cosines in [-1, 1] — the ArcFace input contract)
+    lg = paddle.to_tensor(np.clip(rng.normal(size=(4, 8)), -0.95, 0.95)
+                          .astype(np.float32))
+    lab = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    m = F.margin_cross_entropy(lg, lab, margin1=1.0, margin2=0.0,
+                               margin3=0.0, scale=1.0)
+    plain = F.cross_entropy(lg, lab)
+    np.testing.assert_allclose(float(m), float(plain), rtol=1e-4)
+
+    t = paddle.to_tensor(np.array([-2.0, 3.0], np.float32))
+    r = F.relu_(t)
+    assert r is t
+    np.testing.assert_allclose(t.numpy(), [0.0, 3.0])
+    np.testing.assert_allclose(
+        F.thresholded_relu(paddle.to_tensor(
+            np.array([0.5, 1.5], np.float32)), 1.0).numpy(), [0.0, 1.5])
