@@ -25,13 +25,14 @@ from .stat import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 
 from . import (creation, linalg, logic, manipulation, math, random, search,
-               sequence, stat)
+               sequence, stat, tail)
 
 # ---------------------------------------------------------------------------
 # Method attachment
 # ---------------------------------------------------------------------------
 
-_METHOD_SOURCES = [math, manipulation, logic, search, linalg, stat, creation, random]
+_METHOD_SOURCES = [math, manipulation, logic, search, linalg, stat, creation,
+                   random, tail]
 
 _SKIP = {
     "to_tensor", "zeros", "ones", "full", "arange", "linspace", "eye", "empty",

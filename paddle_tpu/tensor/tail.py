@@ -24,6 +24,7 @@ __all__ = [
     "tanh_", "unsqueeze_", "create_parameter", "batch", "check_shape",
     "set_printoptions", "disable_signal_handler", "flops",
     "diag_embed", "fill_diagonal_", "clip_by_norm", "edit_distance",
+    "flatten_",
 ]
 
 
@@ -305,3 +306,8 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         out[b, 0] = d
     return (Tensor(jnp.asarray(out)),
             Tensor(jnp.asarray(np.array([B], np.int64))))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    from .manipulation import flatten
+    return _rebind(x, flatten(x, start_axis, stop_axis))
