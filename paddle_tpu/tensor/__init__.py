@@ -38,6 +38,9 @@ _SKIP = {
     "to_tensor", "zeros", "ones", "full", "arange", "linspace", "eye", "empty",
     "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
     "standard_normal", "broadcast_shape", "is_tensor", "scatter_nd",
+    # module utilities in tensor.tail that are NOT tensor methods
+    "set_printoptions", "batch", "check_shape", "disable_signal_handler",
+    "flops", "create_parameter", "edit_distance",
 }
 
 
@@ -54,8 +57,8 @@ def _attach_methods():
 _attach_methods()
 
 # Paddle aliases with trailing-underscore in-place-ish semantics
+# (reshape_ comes from tensor.tail with REAL in-place rebinding)
 Tensor.transpose = manipulation.transpose
-Tensor.reshape_ = manipulation.reshape
 Tensor.scale = math.scale
 Tensor.uniform_ = random.uniform_
 Tensor.normal_ = random.normal_
