@@ -34,11 +34,11 @@ from ...core.tensor import TapeNode, Tensor, _wrap_outputs, is_grad_enabled
 from ...nn.layer import Layer
 
 __all__ = ["SparseTable", "DistributedEmbedding", "PSClient",
-           "PSServerHandle", "AsyncCommunicator", "run_server",
-           "role_from_env", "server_endpoints_from_env"]
+           "PSServerHandle", "AsyncCommunicator", "GeoCommunicator",
+           "run_server", "role_from_env", "server_endpoints_from_env"]
 
-from .service import (AsyncCommunicator, PSClient,  # noqa: E402
-                      PSServerHandle, role_from_env, run_server,
+from .service import (AsyncCommunicator, GeoCommunicator,  # noqa: E402
+                      PSClient, PSServerHandle, role_from_env, run_server,
                       server_endpoints_from_env)
 
 

@@ -62,6 +62,7 @@ enum Op : uint8_t {
   kStats = 10,
   kStop = 11,
   kKind = 12,
+  kAddSparse = 13,   // w[key] += delta (geo-SGD aggregation)
 };
 
 enum OptKind : uint8_t { kSGD = 0, kAdagrad = 1, kAdam = 2 };
@@ -296,6 +297,31 @@ void handle_pull_sparse(SparseTable& t, const std::vector<char>& body,
   respond(fd, 0, out.data(), out.size() * sizeof(float));
 }
 
+void handle_add_sparse(SparseTable& t, const std::vector<char>& body,
+                       int fd) {
+  // geo-SGD: workers train locally and push PARAMETER DELTAS which are
+  // summed into the global table (reference: distributed/table geo mode,
+  // communicator.cc GeoCommunicator).
+  if (body.size() < 8) { respond_err(fd, "short request"); return; }
+  const char* p = body.data();
+  uint64_t n = rd<uint64_t>(p);
+  if (n > (body.size() - 8) / 8 ||
+      body.size() != 8 + n * 8 + n * t.dim * sizeof(float)) {
+    respond_err(fd, "add_sparse size mismatch");
+    return;
+  }
+  const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+  const float* vals =
+      reinterpret_cast<const float*>(p + n * sizeof(uint64_t));
+  for (uint64_t i = 0; i < n; ++i) {
+    SparseShard& sh = t.shards[SparseTable::shard_of(keys[i])];
+    std::lock_guard<std::mutex> g(sh.mu);
+    float* w = t.row(sh, keys[i]);
+    for (uint64_t d = 0; d < t.dim; ++d) w[d] += vals[i * t.dim + d];
+  }
+  respond(fd, 0, nullptr, 0);
+}
+
 void handle_push_sparse(SparseTable& t, const std::vector<char>& body,
                         bool is_grad, int fd) {
   if (body.size() < 8) { respond_err(fd, "short request"); return; }
@@ -479,6 +505,12 @@ void serve_conn(Server& srv, int fd) {
         SparseTable* t = srv.sparse_at(table);
         if (!t) { respond_err(fd, "no sparse table"); break; }
         handle_pull_sparse(*t, body, fd);
+        break;
+      }
+      case kAddSparse: {
+        SparseTable* t = srv.sparse_at(table);
+        if (!t) { respond_err(fd, "no sparse table"); break; }
+        handle_add_sparse(*t, body, fd);
         break;
       }
       case kPushSparseGrad:

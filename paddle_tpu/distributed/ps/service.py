@@ -37,7 +37,7 @@ _SRC = os.path.join(os.path.dirname(__file__), "_native", "ps_server.cpp")
 # protocol op codes (keep in sync with ps_server.cpp)
 _PING, _CREATE, _PULL_DENSE, _PUSH_DENSE, _PUSH_DENSE_GRAD = 0, 1, 2, 3, 4
 _PULL_SPARSE, _PUSH_SPARSE_GRAD, _PUSH_SPARSE = 5, 6, 7
-_SAVE, _LOAD, _STATS, _STOP, _KIND = 8, 9, 10, 11, 12
+_SAVE, _LOAD, _STATS, _STOP, _KIND, _ADD_SPARSE = 8, 9, 10, 11, 12, 13
 
 _OPT_KINDS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
@@ -231,7 +231,20 @@ class PSClient:
                 acc = np.zeros((len(uniq), values.shape[1]), np.float32)
                 np.add.at(acc, inv, values)
                 keys, values = uniq, acc
-        op = _PUSH_SPARSE_GRAD if grad else _PUSH_SPARSE
+        self._send_rows(_PUSH_SPARSE_GRAD if grad else _PUSH_SPARSE,
+                        table_id, keys, values)
+
+    def add_sparse(self, table_id: int, keys: np.ndarray,
+                   deltas: np.ndarray) -> None:
+        """w[key] += delta — geo-SGD aggregation (reference: geo tables,
+        communicator.cc GeoCommunicator send path). No client-side dedup:
+        the server's += already sums duplicate keys."""
+        self._send_rows(_ADD_SPARSE, table_id,
+                        np.ascontiguousarray(keys, np.uint64),
+                        np.ascontiguousarray(deltas, np.float32))
+
+    def _send_rows(self, op: int, table_id: int, keys: np.ndarray,
+                   values: np.ndarray) -> None:
         for s, idx in enumerate(self._split(keys)):
             if len(idx) == 0:
                 continue
@@ -356,6 +369,77 @@ class AsyncCommunicator:
         self._thread.join(timeout=30.0)
         if self._err is not None:
             raise RuntimeError("communicator failed") from self._err
+
+
+class GeoCommunicator:
+    """Geo-SGD training mode (reference: communicator.cc GeoCommunicator,
+    distributed/table geo tables): each worker trains a LOCAL copy of the
+    touched rows and periodically pushes parameter DELTAS, which servers
+    sum — communication-efficient async training for sparse models.
+
+    Usage: `pull(keys)` serves rows from a local trainable cache,
+    `update(keys, rows)` writes trained rows back, and `maybe_sync()`
+    (call once per step) pushes `local - base` deltas and refreshes the
+    base every `trigger_steps`.
+    """
+
+    def __init__(self, client: PSClient, table_id: int, dim: int,
+                 trigger_steps: int = 10):
+        self._client = client
+        self._table = table_id
+        self._dim = dim
+        self._trigger = trigger_steps
+        self._step = 0
+        self._local: Dict[int, np.ndarray] = {}   # key -> current row
+        self._base: Dict[int, np.ndarray] = {}    # key -> row at last sync
+        self._dirty: set = set()                  # keys updated since sync
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Rows for `keys`, served from the local cache when present."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        if len(keys) == 0:
+            return np.zeros((0, self._dim), np.float32)
+        missing = [int(k) for k in keys if int(k) not in self._local]
+        if missing:
+            fetched = self._client.pull_sparse(
+                self._table, np.asarray(missing, np.uint64), self._dim)
+            for k, row in zip(missing, fetched):
+                self._local[k] = row.copy()
+                self._base[k] = row.copy()
+        return np.stack([self._local[int(k)] for k in keys])
+
+    def update(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Write locally-trained rows back into the cache."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        for k, row in zip(keys, np.asarray(rows, np.float32)):
+            self._local[int(k)] = row.copy()
+            self._dirty.add(int(k))
+
+    def maybe_sync(self) -> bool:
+        """Every trigger_steps: push accumulated deltas, refresh bases
+        from the server (absorbing other workers' deltas)."""
+        self._step += 1
+        if self._step % self._trigger:
+            return False
+        if self._dirty:
+            # only the keys touched since the last sync travel (the
+            # reference GeoCommunicator keeps the same delta-id sets);
+            # untouched cache entries are dropped so the local cache does
+            # not grow with the worker's lifetime key set
+            keys = np.fromiter(self._dirty, np.uint64, len(self._dirty))
+            deltas = np.stack([self._local[int(k)] - self._base[int(k)]
+                               for k in keys])
+            self._client.add_sparse(self._table, keys, deltas)
+            fresh = self._client.pull_sparse(self._table, keys, self._dim)
+            clean = set(self._local) - self._dirty
+            for k, row in zip(keys, fresh):
+                self._local[int(k)] = row.copy()
+                self._base[int(k)] = row.copy()
+            for k in clean:
+                self._local.pop(k, None)
+                self._base.pop(k, None)
+            self._dirty.clear()
+        return True
 
 
 # ---------------------------------------------------------------------------

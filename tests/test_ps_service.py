@@ -147,3 +147,33 @@ def test_fresh_client_discovers_table_kind(cluster, tmp_path):
     assert len(os.listdir(tmp_path / "dense_ckpt")) == 1   # owner only
     assert fresh.table_kind(99) == "absent"
     fresh.close()
+
+
+def test_geo_sgd_two_workers_converge(cluster):
+    """Geo mode: two 'workers' train local rows, push deltas; both see the
+    combined result after sync (reference: GeoCommunicator)."""
+    _, client = cluster
+    client.create_table(7, kind="sparse", dim=4, optimizer="sgd", lr=1.0,
+                        seed=0, init_scale=0.0)
+    w1 = svc.GeoCommunicator(client, 7, 4, trigger_steps=2)
+    w2 = svc.GeoCommunicator(client, 7, 4, trigger_steps=2)
+    keys = np.array([3], np.uint64)
+
+    # worker 1 trains its local row by +1 per step; worker 2 by +10
+    for step in range(2):
+        r1 = w1.pull(keys)
+        w1.update(keys, r1 + 1.0)
+        w1.maybe_sync()
+        r2 = w2.pull(keys)
+        w2.update(keys, r2 + 10.0)
+        w2.maybe_sync()
+    # after both synced: server row = sum of both workers' deltas
+    server_row = client.pull_sparse(7, keys, 4)
+    np.testing.assert_allclose(server_row, 22.0, atol=1e-5)
+    # a fresh sync refreshes worker 1's base to the combined value
+    w1.pull(keys)
+    for _ in range(2):
+        r1 = w1.pull(keys)
+        w1.update(keys, r1)      # no local change
+        w1.maybe_sync()
+    np.testing.assert_allclose(w1.pull(keys), 22.0, atol=1e-5)
