@@ -29,6 +29,10 @@ white_list = {
     "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
     "scaled_dot_product_attention", "einsum", "embedding",
+    # the model zoo's fused matmul-class ops (GPT/BERT/ERNIE attention
+    # projections and LM heads) — without these the attention branch of
+    # the residual stream silently rides f32 under O1
+    "fused_qkv", "attn_out", "mlm_head", "ernie_mlm_head", "lm_logits",
 }
 
 # Ops kept in fp32 even under O2 (numerically sensitive). `layer_norm` is
