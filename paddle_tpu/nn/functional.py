@@ -826,6 +826,13 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     key = make_rng(rng_name)
 
     def _do(a):
+        if axis is None and mode == "upscale_in_train" \
+                and a.size >= 65536 and jax.default_backend() == "tpu":
+            # single-pass Pallas kernel: in-kernel counter-based mask,
+            # regenerated in the backward — one HBM read + one write
+            # instead of XLA's bits/mask/product round-trips
+            from ..ops.pallas.dropout import fused_dropout
+            return fused_dropout(a, p, key)
         if axis is None:
             shape = a.shape
         else:

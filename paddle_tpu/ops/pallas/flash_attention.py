@@ -98,19 +98,13 @@ def _dropout_keep(seed_ref, b, h, qi, ki, shape, rate):
         .astype(jnp.uint32)
     bh = (b.astype(jnp.uint32) * jnp.uint32(0xAC564B05)
           + h.astype(jnp.uint32) * jnp.uint32(19349663))
-    x = (rows * jnp.uint32(0x9E3779B1)
-         ^ cols * jnp.uint32(0x85EBCA6B)
-         ^ bh
-         ^ seed_ref[0].astype(jnp.uint32)
-         ^ (seed_ref[1].astype(jnp.uint32) << 1))
-    # murmur3 fmix32
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x85EBCA6B)
-    x = x ^ (x >> 13)
-    x = x * jnp.uint32(0xC2B2AE35)
-    x = x ^ (x >> 16)
-    thresh = jnp.uint32(min(rate, 0.999999) * 4294967296.0)
-    keep = x >= thresh
+    from .rng import fmix32, keep_threshold
+    x = fmix32(rows * jnp.uint32(0x9E3779B1)
+               ^ cols * jnp.uint32(0x85EBCA6B)
+               ^ bh
+               ^ seed_ref[0].astype(jnp.uint32)
+               ^ (seed_ref[1].astype(jnp.uint32) << 1))
+    keep = x >= keep_threshold(rate)
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 

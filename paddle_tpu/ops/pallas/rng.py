@@ -1,0 +1,26 @@
+"""Shared stateless-RNG pieces for the Pallas kernels.
+
+One fmix32 + threshold definition keeps the flash-attention in-kernel
+dropout and the fused dropout kernel bit-identical by construction (the
+backward passes REGENERATE masks from these)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fmix32", "keep_threshold"]
+
+
+def fmix32(x):
+    """murmur3 finalizer over uint32 lanes."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def keep_threshold(rate: float):
+    """uint32 threshold with P(hash >= t) = 1 - rate."""
+    return jnp.uint32(min(rate, 0.999999) * 4294967296.0)
