@@ -206,7 +206,11 @@ def bench_lenet_eager() -> None:
 
 
 def bench_resnet50() -> None:
-    """Config 2: ResNet-50 jitted img/s — diagnostic only."""
+    """Config 2: ResNet-50 jitted img/s — diagnostic only.
+
+    AMP O1 + B=256 (v5e sweep: f32 B=64 848 img/s, f32 B=128 1080,
+    AMP B=128 1519, AMP B=256 1649 — bf16 activations halve HBM traffic
+    and unlock the larger batch)."""
     try:
         import paddle_tpu as paddle
         from paddle_tpu.jit.to_static import TrainStep
@@ -214,12 +218,13 @@ def bench_resnet50() -> None:
         from paddle_tpu.optimizer import Momentum
         from paddle_tpu.vision.models import resnet50
 
-        B = 64
+        B = 256
         paddle.seed(0)
         model = resnet50(num_classes=1000)
 
         def loss_fn(layer, xb, yb):
-            return F.cross_entropy(layer(xb), yb)
+            with paddle.amp.auto_cast(level="O1"):
+                return F.cross_entropy(layer(xb), yb)
 
         opt = Momentum(learning_rate=0.1, parameters=model.parameters(),
                        momentum=0.9, weight_decay=1e-4)

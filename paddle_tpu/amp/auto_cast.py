@@ -52,7 +52,8 @@ _tls = threading.local()
 
 
 class _AmpState:
-    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black",
+                 "wl", "bl")
 
     def __init__(self, enabled, dtype, level, custom_white, custom_black):
         self.enabled = enabled
@@ -60,6 +61,11 @@ class _AmpState:
         self.level = level
         self.custom_white = custom_white or set()
         self.custom_black = custom_black or set()
+        # effective lists resolved ONCE per context (the custom lists are
+        # fixed for the state's lifetime; per-op set unions would sit on
+        # the hot eager dispatch path)
+        self.wl = (white_list | self.custom_white) - self.custom_black
+        self.bl = black_list | self.custom_black
 
 
 def amp_state():
@@ -82,26 +88,28 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
-def amp_cast_inputs(op_name: str, arrays):
-    """Called by the dispatch layer: cast raw arrays per the active policy."""
-    st = amp_state()
+def _cast_target(op_name: str, st):
+    """The ONE policy resolver: target jnp dtype for op inputs, or None
+    (leave dtypes alone). Both the actual cast and the cache token derive
+    from this, so they can never desynchronize."""
     if st is None or not st.enabled:
-        return arrays
-    low = st.dtype
-    wl = (white_list | st.custom_white) - st.custom_black
-    bl = black_list | st.custom_black
+        return None
     if st.level == "O2":
-        if op_name in bl:
-            target = jnp.float32
-        else:
-            target = low
-    else:  # O1
-        if op_name in wl:
-            target = low
-        elif op_name in bl:
-            target = jnp.float32
-        else:
-            return arrays
+        return jnp.float32 if op_name in st.bl else st.dtype
+    if op_name in st.wl:
+        return st.dtype
+    if op_name in st.bl:
+        return jnp.float32
+    return None
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Cast raw arrays per the active policy (kept for direct callers;
+    the dispatch layer resolves the target once per op via
+    amp_target_dtype and casts inline)."""
+    target = _cast_target(op_name, amp_state())
+    if target is None:
+        return arrays
     out = []
     for a in arrays:
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
@@ -112,9 +120,19 @@ def amp_cast_inputs(op_name: str, arrays):
     return out
 
 
-from ..core.tensor import set_amp_hook  # noqa: E402
+def amp_target_dtype(op_name: str):
+    """Dispatch-layer hook: the cast-target dtype STRING for this op
+    under the active policy, or None. Resolved once at op-dispatch time —
+    the value (not the thread-local state) is captured by any deferred
+    trace, so a backward jitted outside the autocast context still
+    replays the forward's policy."""
+    target = _cast_target(op_name, amp_state())
+    return None if target is None else str(jnp.dtype(target))
 
-set_amp_hook(amp_cast_inputs)
+
+from ..core.tensor import set_amp_target_hook  # noqa: E402
+
+set_amp_target_hook(amp_target_dtype)
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",

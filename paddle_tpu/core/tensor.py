@@ -376,7 +376,7 @@ def _unwrap_index(idx):
 # Op dispatch
 # ---------------------------------------------------------------------------
 
-_amp_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
+_amp_target_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
 _op_profile_hook: Optional[Callable] = None  # installed by paddle_tpu.profiler
 
 # Eager-op jit cache (FLAGS_eager_jit_ops, reference analogue: the op-cache
@@ -426,9 +426,12 @@ def _eager_cache_put(key, ent):
         _EAGER_FN_CACHE.popitem(last=False)
 
 
-def set_amp_hook(fn):
-    global _amp_hook
-    _amp_hook = fn
+def set_amp_target_hook(fn):
+    """Install the autocast policy resolver: fn(op_name) -> dtype str or
+    None. Resolved ONCE per apply() so deferred traces replay the
+    forward's policy instead of reading thread-local state later."""
+    global _amp_target_hook
+    _amp_target_hook = fn
 
 
 def set_op_profile_hook(fn):
@@ -447,8 +450,25 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
     over (never differentiated).
     """
     raw = [a._data if isinstance(a, Tensor) else a for a in args]
-    if _amp_hook is not None:
-        raw = _amp_hook(name, raw)
+    # the AMP cast must live INSIDE the differentiated function: applied to
+    # the primals outside, jax.vjp would hand back cotangents in the CAST
+    # dtype while the producing op's output carries the original dtype —
+    # an eager-tape dtype mismatch across any black/white-listed boundary.
+    # The policy is resolved HERE to a concrete target dtype: deferred
+    # traces (the lazily-jitted cached backward) capture the VALUE, never
+    # re-reading thread-local autocast state at trace time.
+    _amp_target = (_amp_target_hook(name)
+                   if _amp_target_hook is not None else None)
+
+    def _amp(vals):
+        if _amp_target is None:
+            return vals
+        td = jnp.dtype(_amp_target)
+        return [v.astype(td)
+                if hasattr(v, "dtype") and jnp.issubdtype(v.dtype,
+                                                          jnp.floating)
+                and v.dtype != td else v
+                for v in vals]
 
     record = False
     if is_grad_enabled():
@@ -462,10 +482,12 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
                 _is_tracer(a) for a in raw):
             import time as _time
             t0 = _time.perf_counter()
-            out = fn(*raw, **static_kw) if static_kw else fn(*raw)
+            cast = _amp(raw)
+            out = fn(*cast, **static_kw) if static_kw else fn(*cast)
             _op_profile_hook(name or "unnamed", _time.perf_counter() - t0)
             return _wrap_outputs(out, node=None)
-        out = fn(*raw, **static_kw) if static_kw else fn(*raw)
+        cast = _amp(raw)
+        out = fn(*cast, **static_kw) if static_kw else fn(*cast)
         return _wrap_outputs(out, node=None)
 
     diff_idx = [
@@ -479,6 +501,7 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
         vals = list(raw)
         for i, v in zip(diff_idx, diff_vals):
             vals[i] = v
+        vals = _amp(vals)
         return fn(*vals, **static_kw) if static_kw else fn(*vals)
 
     t0 = None
@@ -492,8 +515,14 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
         # all-array args only: jitting would trace positional python
         # scalars that the fn may use structurally (axis/shape values)
         try:
+            # the AMP policy is applied INSIDE the jitted fns (so the vjp
+            # casts cotangents back to the caller dtypes); its outcome must
+            # therefore be part of the cache key — an op traced under one
+            # autocast policy cannot serve another
+            amp_token = _amp_target
             key = (id(fn), name, tuple(diff_idx),
                    tuple((a.shape, str(a.dtype)) for a in raw),
+                   amp_token,
                    tuple(sorted(static_kw.items())) if static_kw else ())
             hash(key)
         except TypeError:
@@ -501,6 +530,7 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
         cached = _eager_cache_get(key) if key is not None else None
         if cached is None and key is not None:
             def fwd_fn(vals):
+                vals = _amp(vals)
                 return fn(*vals, **static_kw) if static_kw else fn(*vals)
 
             def bwd_fn(vals, cots):
@@ -508,6 +538,7 @@ def apply(fn: Callable, *args, name: str = "", **static_kw):
                     vs = list(vals)
                     for i, v in zip(diff_idx, dv):
                         vs[i] = v
+                    vs = _amp(vs)
                     return fn(*vs, **static_kw) if static_kw else fn(*vs)
                 _, vjp = jax.vjp(f, *(vals[i] for i in diff_idx))
                 return vjp(cots)

@@ -253,14 +253,15 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n)
             pad = [(pi, pi) for pi in p]
 
     def _conv(a, w, *maybe_bias):
+        # NOTE: no preferred_element_type here — XLA:TPU already
+        # accumulates bf16 convs in f32 internally, and requesting an f32
+        # OUTPUT breaks jax's conv transpose rule under autocast (mixed
+        # bf16 primal / f32 cotangent in the rhs rule)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=spec,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
         )
-        if out.dtype != a.dtype:
-            out = out.astype(a.dtype)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim

@@ -184,3 +184,39 @@ def test_eager_jit_cache_skips_closures():
     a = np.asarray(paddle.nn.functional.dropout(x, 0.5)._data)
     b = np.asarray(paddle.nn.functional.dropout(x, 0.5)._data)
     assert not np.array_equal(a, b)
+
+
+def test_amp_eager_backward_across_listed_boundaries():
+    """The AMP cast lives INSIDE the taped function: eager backward must
+    work across white/black-listed op boundaries (conv -> bn), and a
+    backward issued OUTSIDE the autocast context must replay the
+    forward's policy in the deferred cached trace."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    bn = nn.BatchNorm2D(8)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(2, 3, 8, 8)).astype(np.float32))
+    x.stop_gradient = False
+    with paddle.amp.auto_cast(level="O1"):
+        y = bn(conv(x))
+    y.sum().backward()                 # outside the context
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._data, dtype=np.float32)).all()
+
+    # deferred cached backward of a black-listed cacheable op
+    z = paddle.to_tensor(np.ones((64, 64), np.float32))
+    z._data = z._data.astype(jnp.bfloat16)
+    z.stop_gradient = False
+    with paddle.amp.auto_cast(level="O1"):
+        e = paddle.exp(z)              # black-listed: f32 compute
+    assert str(e.dtype) == "float32"
+    e.sum().backward()
+    assert str(z.grad.dtype) == "bfloat16"
+    np.testing.assert_allclose(np.asarray(z.grad._data, np.float32),
+                               np.e, rtol=2e-2)
