@@ -103,23 +103,6 @@ def _cast_target(op_name: str, st):
     return None
 
 
-def amp_cast_inputs(op_name: str, arrays):
-    """Cast raw arrays per the active policy (kept for direct callers;
-    the dispatch layer resolves the target once per op via
-    amp_target_dtype and casts inline)."""
-    target = _cast_target(op_name, amp_state())
-    if target is None:
-        return arrays
-    out = []
-    for a in arrays:
-        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
-                and a.dtype != target:
-            out.append(a.astype(target))
-        else:
-            out.append(a)
-    return out
-
-
 def amp_target_dtype(op_name: str):
     """Dispatch-layer hook: the cast-target dtype STRING for this op
     under the active policy, or None. Resolved once at op-dispatch time —

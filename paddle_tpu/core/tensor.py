@@ -442,7 +442,29 @@ def set_op_profile_hook(fn):
     _op_profile_hook = fn
 
 
+# stack of active static-graph recorders (paddle_tpu.static program_guard)
+_static_recorders: List[Any] = []
+
+
+def push_static_recorder(rec):
+    _static_recorders.append(rec)
+
+
+def pop_static_recorder():
+    return _static_recorders.pop()
+
+
 def apply(fn: Callable, *args, name: str = "", **static_kw):
+    """Execute ``fn`` over raw arrays; record a VJP tape node if needed;
+    when a static-graph recorder is active (static.program_guard), also
+    append the op to the recording program for feed/fetch replay."""
+    result = _apply_impl(fn, *args, name=name, **static_kw)
+    if _static_recorders:
+        _static_recorders[-1]._record_op(fn, name, static_kw, args, result)
+    return result
+
+
+def _apply_impl(fn: Callable, *args, name: str = "", **static_kw):
     """Execute ``fn`` over raw arrays; record a VJP tape node if needed.
 
     ``args`` may mix Tensors and array-likes/scalars; only float Tensor args

@@ -172,8 +172,17 @@ class Optimizer:
             p._data = new_params[k]
             self._accumulators[id(p)] = new_state[k]
 
-    # reference's minimize(): compute backward then step
+    # reference's minimize(): compute backward then step; under an active
+    # static.program_guard it instead ATTACHES this optimizer to the
+    # recording program (the reference appends backward+optimizer ops to
+    # the program the same way)
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core.tensor import _static_recorders
+        if _static_recorders:
+            prog = _static_recorders[-1]
+            prog._optimizer = self
+            prog._loss = loss
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -33,13 +33,85 @@ def _in_static_mode():
 
 
 class Program:
-    """A recorded pure function over named inputs (ProgramDesc analogue)."""
+    """A RECORDED op graph (ProgramDesc analogue).
+
+    Building: inside ``program_guard(main)``, `static.data` placeholders
+    and every op dispatched through the framework (core.tensor.apply) are
+    appended to this program — the eager execution doubles as the build
+    pass (the reference traces ops into blocks the same way,
+    framework.py Program/Block append_op). Running: `Executor.run`
+    replays the recorded ops as ONE jit-compiled pure function over
+    (feeds, parameters), with `optimizer.minimize` turning the replay
+    into a fused grad+update train step.
+    """
 
     def __init__(self):
-        self._build_fn = None  # set by program_guard recording
         self._inputs: Dict[str, InputSpec] = {}
         self._fetch: List = []
         self.random_seed = 0
+        # recorded graph state
+        self._ops: List = []            # (fn, name, static_kw, in_spec, out_ids)
+        self._placeholders: Dict[str, Tensor] = {}
+        self._tensors: Dict[int, Tensor] = {}   # keep intermediates alive
+        self._params: Dict[int, Tensor] = {}
+        self._optimizer = None
+        self._loss = None
+        self._run_cache: Dict = {}
+
+    # -- recording (called by core.tensor.apply) ------------------------
+    def _record_op(self, fn, name, static_kw, args, result):
+        in_spec = []
+        for a in args:
+            if isinstance(a, Tensor):
+                self._tensors[id(a)] = a
+                from ..core.tensor import Parameter
+                if isinstance(a, Parameter) or getattr(a, "persistable",
+                                                       False):
+                    self._params[id(a)] = a
+                in_spec.append(("t", id(a)))
+            else:
+                in_spec.append(("c", a))
+        outs = result if isinstance(result, (tuple, list)) else [result]
+        out_ids = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                self._tensors[id(o)] = o
+                out_ids.append(id(o))
+            else:
+                out_ids.append(None)
+        self._ops.append((fn, name, static_kw, in_spec, out_ids))
+
+    def add_placeholder(self, name, tensor):
+        self._placeholders[name] = tensor
+        self._tensors[id(tensor)] = tensor
+
+    def _replay(self, env):
+        """Execute recorded ops over env: {tensor_id: array}. Returns env
+        (mutated). Values not in env resolve to their recorded arrays."""
+        for fn, name, static_kw, in_spec, out_ids in self._ops:
+            vals = [(env[v] if v in env else self._tensors[v]._data)
+                    if kind == "t" else v
+                    for kind, v in in_spec]
+            out = fn(*vals, **static_kw) if static_kw else fn(*vals)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for oid, o in zip(out_ids, outs):
+                if oid is not None:
+                    env[oid] = o
+        return env
+
+    def leaf_ids(self):
+        """Tensor inputs that are neither op outputs nor placeholders:
+        parameters, buffers, captured constants. Passed FRESH into every
+        replay so state reads are never baked as trace constants."""
+        produced = {oid for *_, out_ids in self._ops for oid in out_ids
+                    if oid is not None}
+        ph = {id(t) for t in self._placeholders.values()}
+        leaves = []
+        for fn, name, static_kw, in_spec, out_ids in self._ops:
+            for kind, v in in_spec:
+                if kind == "t" and v not in produced and v not in ph:
+                    leaves.append(v)
+        return sorted(set(leaves))
 
     def global_block(self):
         return self
@@ -63,24 +135,34 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    """Route `static.data` and ALL dispatched ops into `main_program`
+    (reference: fluid/framework.py program_guard): the eager build pass
+    records a replayable graph."""
+    from ..core.tensor import pop_static_recorder, push_static_recorder
     prev_m = _default_main[0]
     prev_s = _default_startup[0]
     _default_main[0] = main_program
     if startup_program is not None:
         _default_startup[0] = startup_program
+    push_static_recorder(main_program)
     try:
         yield
     finally:
+        pop_static_recorder()
         _default_main[0] = prev_m
         _default_startup[0] = prev_s
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Declare a graph input (reference: fluid/data.py). In eager-first mode
-    this returns a zero placeholder Tensor tagged with its name."""
+    """Declare a graph input (reference: fluid/data.py): a placeholder
+    Tensor (zeros at build time; None dims become 1) registered with the
+    active recording program so Executor.run can substitute feeds."""
     shape = tuple(1 if (d is None or d < 0) else d for d in shape)
     t = Tensor(np.zeros(shape, np.dtype(dtypes.convert_dtype(dtype))))
     t.name = name
+    prog = _default_main[0]
+    if prog is not None:
+        prog.add_placeholder(name, t)
     return t
 
 
@@ -127,7 +209,11 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
-        if isinstance(program, CompiledProgram):
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, Program):
+            outs = self._run_recorded(program, feed or {}, fetch_list or [])
+        elif isinstance(program, CompiledProgram):
             outs = program._run(feed or {})
         elif callable(program):
             # memoize per callable: repeated exe.run(fn, ...) hits the same
@@ -147,6 +233,107 @@ class Executor:
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    def _run_recorded(self, program: Program, feed, fetch_list):
+        """Replay a recorded Program as one jitted pure function over
+        (feeds, params); with an attached optimizer, also compute grads
+        and apply the update (the reference's static training loop)."""
+        if not program._ops:
+            if fetch_list:
+                raise ValueError(
+                    "this Program has no recorded ops — build it inside "
+                    "`with static.program_guard(program):` before fetching")
+            return []                     # e.g. startup program
+        import jax as _jax
+
+        fetch_ids = []
+        for fv in fetch_list:
+            if isinstance(fv, Tensor):
+                fetch_ids.append(id(fv))
+            else:
+                raise TypeError(
+                    "fetch_list entries must be Tensors built inside "
+                    "program_guard (names are not tracked)")
+        feed_arrs = {}
+        for name, v in feed.items():
+            ph = program._placeholders.get(name)
+            if ph is None:
+                raise KeyError(
+                    f"feed {name!r} is not a static.data placeholder of "
+                    f"this program (have: {list(program._placeholders)})")
+            feed_arrs[id(ph)] = jax.numpy.asarray(
+                v._data if isinstance(v, Tensor) else np.asarray(v))
+        missing = [n for n, t in program._placeholders.items()
+                   if id(t) not in feed_arrs]
+        if missing:
+            raise KeyError(
+                f"placeholders {missing} were not fed (an unfed "
+                "placeholder would silently replay its build-time zeros)")
+
+        import jax.numpy as jnp
+        params = {pid: t for pid, t in program._params.items()
+                  if jnp.issubdtype(t._data.dtype, jnp.floating)}
+        # ALL leaves (params, buffers, captured tensors) enter the jitted
+        # replay as arguments, re-read each run — never baked as
+        # trace-time constants (running stats would otherwise freeze).
+        # NOTE: buffer WRITES are not replayed; mutation-during-training
+        # state (BatchNorm running stats) updates only on the eager build
+        # pass — train BN models eagerly or with use_global_stats.
+        leaf_arrs = {lid: program._tensors[lid]._data
+                     for lid in program.leaf_ids()}
+        param_arrs = {pid: leaf_arrs.pop(pid)
+                      for pid in list(params)
+                      if pid in leaf_arrs}
+        train = program._optimizer is not None and program._loss is not None
+
+        sig = (id(program), len(program._ops), tuple(sorted(feed_arrs)),
+               tuple((a.shape, str(a.dtype)) for _, a in
+                     sorted(feed_arrs.items())), tuple(fetch_ids), train)
+        fns = program._run_cache.get(sig)
+        if fns is None:
+            def forward(feed_d, param_d, leaf_d):
+                env = dict(feed_d)
+                env.update(leaf_d)
+                env.update(param_d)
+                env = program._replay(env)
+                return [env[fid] for fid in fetch_ids]
+
+            fwd_jit = _jax.jit(forward)
+            grad_jit = None
+            if train:
+                loss_id = id(program._loss)
+
+                def loss_fn(param_d, feed_d, leaf_d):
+                    env = dict(feed_d)
+                    env.update(leaf_d)
+                    env.update(param_d)
+                    env = program._replay(env)
+                    fetched = [env[fid] for fid in fetch_ids]
+                    return env[loss_id].astype(jax.numpy.float32), fetched
+
+                grad_jit = _jax.jit(_jax.value_and_grad(loss_fn,
+                                                        has_aux=True))
+            fns = (fwd_jit, grad_jit)
+            program._run_cache[sig] = fns
+        fwd_jit, grad_jit = fns
+
+        if train:
+            (_, fetched), grads = grad_jit(param_arrs, feed_arrs, leaf_arrs)
+            # hand gradients to the optimizer's own fused update
+            for pid, t in params.items():
+                g = grads.get(pid)
+                if g is not None and getattr(t, "trainable", True):
+                    t.grad = Tensor(g)
+            opt = program._optimizer
+            if opt._parameter_list is None:
+                # `SGD(lr).minimize(loss)` static pattern: adopt the
+                # program's parameters
+                opt._parameter_list = [t for t in params.values()
+                                       if getattr(t, "trainable", True)]
+            opt.step()
+            program._optimizer.clear_grad()
+            return fetched
+        return fwd_jit(feed_arrs, param_arrs, leaf_arrs)
 
 
 # static-style layer helpers + functional control flow live in static.nn
