@@ -1,0 +1,335 @@
+"""Detection-op tail: matching, FPN routing, box utilities, plus the
+ranking/recsys losses that ride the same SSD/CTR pipelines.
+
+reference parity: fluid/layers/detection.py — bipartite_match(:1324,
+greedy max-distance column->row matching, operators/detection/
+bipartite_match_op.cc), box_clip(:3050), density_prior_box(:1932),
+distribute_fpn_proposals(:3680), collect_fpn_proposals(:3878);
+fluid/layers/loss.py — bpr_loss(:156), center_loss(:57);
+fluid/layers/nn.py — add_position_encoding(:13231);
+operators/cvm_op.cc (continuous-value model feature op).
+
+TPU-native notes: bipartite matching is a sequential greedy argmax — a
+`lax.scan` over columns with row masking (static shapes, jittable);
+FPN distribute keeps static shapes by returning per-level MASKS +
+reordered indices instead of ragged splits (callers gather with the
+mask counts); the rest are elementwise/index math.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+
+__all__ = ["bipartite_match", "box_clip", "density_prior_box",
+           "distribute_fpn_proposals", "collect_fpn_proposals",
+           "bpr_loss", "center_loss", "cvm", "add_position_encoding",
+           "crf_decoding"]
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy max-distance bipartite matching (reference:
+    detection.py:1324 / bipartite_match_op.cc BipartiteMatch).
+
+    dist_matrix: [R, C] (rows = candidates, cols = targets... reference
+    matches each COLUMN to a row). Returns (match_indices [1, C] int32
+    with -1 for unmatched, match_distance [1, C] f32). match_type
+    'per_prediction' additionally matches unassigned columns to their
+    argmax row when distance > dist_threshold.
+    """
+
+    def _match(d):
+        R, C = d.shape
+        NEG = jnp.asarray(-1e30, d.dtype)
+
+        def step(carry, _):
+            dm, col_idx, col_dist = carry
+            # best remaining (row, col) pair; row/col exclusion is the
+            # NEG fill of the chosen row and column
+            flat = jnp.argmax(dm)
+            r, c = flat // C, flat % C
+            best = dm[r, c]
+            valid = best > NEG / 2
+            col_idx = jnp.where(valid, col_idx.at[c].set(r.astype(jnp.int32)),
+                                col_idx)
+            col_dist = jnp.where(valid, col_dist.at[c].set(best), col_dist)
+            dm = jnp.where(valid, dm.at[r, :].set(NEG).at[:, c].set(NEG), dm)
+            return (dm, col_idx, col_dist), None
+
+        n = min(R, C)
+        init = (d.astype(jnp.float32),
+                jnp.full((C,), -1, jnp.int32),
+                jnp.zeros((C,), jnp.float32))
+        (dm, col_idx, col_dist), _ = lax.scan(step, init, None, length=n)
+
+        if match_type == "per_prediction":
+            thr = 0.5 if dist_threshold is None else float(dist_threshold)
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_val = jnp.max(d, axis=0).astype(jnp.float32)
+            take = (col_idx < 0) & (best_val > thr)
+            col_idx = jnp.where(take, best_row, col_idx)
+            col_dist = jnp.where(take, best_val, col_dist)
+        return col_idx[None, :], col_dist[None, :]
+
+    return apply(_match, dist_matrix, name="bipartite_match")
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image boundaries (reference: detection.py:3050;
+    im_info rows are [height, width, scale])."""
+
+    def _clip(boxes, info):
+        h = info[..., 0] / info[..., 2]
+        w = info[..., 1] / info[..., 2]
+        hm = (h - 1.0).reshape((-1,) + (1,) * (boxes.ndim - 2))
+        wm = (w - 1.0).reshape((-1,) + (1,) * (boxes.ndim - 2))
+        x1 = jnp.clip(boxes[..., 0], 0.0, None)
+        y1 = jnp.clip(boxes[..., 1], 0.0, None)
+        x2 = boxes[..., 2]
+        y2 = boxes[..., 3]
+        if boxes.ndim >= 2:
+            x1 = jnp.minimum(x1, wm)
+            y1 = jnp.minimum(y1, hm)
+            x2 = jnp.clip(jnp.minimum(x2, wm), 0.0, None)
+            y2 = jnp.clip(jnp.minimum(y2, hm), 0.0, None)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply(_clip, input, im_info, name="box_clip")
+
+
+def density_prior_box(input, image=None, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Density prior boxes (reference: detection.py:1932 /
+    density_prior_box_op.h): per feature-map cell, boxes of the fixed
+    sizes/ratios on density x density sub-grids spaced by
+    step_average/density (step_average = int((step_w + step_h)/2), the
+    reference's spacing — NOT the box size).
+
+    Pure index math over static shapes: computed host-side with
+    vectorized numpy (like prior_box), no device op involved."""
+    import numpy as np
+
+    densities = list(densities or [])
+    fixed_sizes = list(fixed_sizes or [])
+    fixed_ratios = list(fixed_ratios or [])
+
+    feat = input._data if isinstance(input, Tensor) else input
+    img = (image._data if isinstance(image, Tensor) else image) \
+        if image is not None else feat
+    H, W = int(feat.shape[-2]), int(feat.shape[-1])
+    img_h, img_w = int(img.shape[-2]), int(img.shape[-1])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    step_avg = int((step_w + step_h) * 0.5)
+
+    cx = (np.arange(W) + offset) * step_w                 # [W]
+    cy = (np.arange(H) + offset) * step_h                 # [H]
+    per_cell = []
+    for size, dens in zip(fixed_sizes, densities):
+        shift = step_avg / dens
+        sub = -step_avg / 2.0 + shift / 2.0 + np.arange(dens) * shift
+        for ratio in fixed_ratios:
+            bw = size * math.sqrt(ratio) / 2.0
+            bh = size / math.sqrt(ratio) / 2.0
+            dxx, dyy = np.meshgrid(sub, sub)              # [dens, dens]
+            per_cell.append(np.stack(
+                [dxx - bw, dyy - bh, dxx + bw, dyy + bh],
+                axis=-1).reshape(-1, 4))
+    offsets = np.concatenate(per_cell, axis=0)            # [K, 4]
+    cxy = np.stack(np.meshgrid(cx, cy), axis=-1)          # [H, W, 2] (x, y)
+    centers = np.concatenate([cxy, cxy], axis=-1)         # [H, W, 4]
+    out = centers[:, :, None, :] + offsets[None, None]    # [H, W, K, 4]
+    out = out / np.array([img_w, img_h, img_w, img_h], np.float32)
+    out = out.astype(np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).astype(np.float32)
+    if flatten_to_2d:
+        out, var = out.reshape(-1, 4), var.reshape(-1, 4).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference: detection.py:3680).
+
+    TPU-native static shapes: returns (multi_rois, restore_ind,
+    level_counts) where `multi_rois` is a list with ONE [N, 4] tensor per
+    level holding that level's rois FIRST (padded with zeros after
+    `level_counts[i]` rows) — callers slice with the counts; restore_ind
+    [N, 1] maps the concatenated per-level order back to the input order.
+    """
+    nlevels = max_level - min_level + 1
+
+    def _dist(rois):
+        area = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0) * \
+            jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+        scale = jnp.sqrt(area)
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        N = rois.shape[0]
+        order = jnp.argsort(lvl, stable=True)
+        counts = []
+        for i in range(nlevels):
+            mask = lvl == (min_level + i)
+            cnt = jnp.sum(mask.astype(jnp.int32))
+            # stable-sort rois of this level to the front
+            key = jnp.where(mask, 0, 1)
+            idx = jnp.argsort(key, stable=True)
+            outs.append(jnp.where((jnp.arange(N) < cnt)[:, None],
+                                  rois[idx], 0.0))
+            counts.append(cnt)
+        restore = jnp.argsort(order, stable=True).astype(jnp.int32)[:, None]
+        return tuple(outs) + (restore, jnp.stack(counts))
+
+    res = apply(_dist, fpn_rois, name="distribute_fpn_proposals")
+    multi_rois = list(res[:nlevels])
+    restore_ind, counts = res[nlevels], res[nlevels + 1]
+    # counts (rois per level) are ALWAYS returned — the static-shape
+    # padding makes them load-bearing, unlike the reference where the
+    # ragged splits carry their own lengths
+    return multi_rois, restore_ind, counts
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level proposals and keep the top-scoring
+    `post_nms_top_n` (reference: detection.py:3878). Static shapes: with
+    `rois_num_per_level` (the counts from distribute_fpn_proposals),
+    pad rows beyond each level's count are masked to -inf so they can
+    never outrank real proposals."""
+    k = len(multi_rois)
+
+    def _collect(*arrs):
+        rois_l = arrs[:k]
+        scores_l = [a.reshape(-1) for a in arrs[k:2 * k]]
+        if rois_num_per_level is not None:
+            counts = arrs[2 * k]
+            scores_l = [jnp.where(jnp.arange(s.shape[0]) < counts[i],
+                                  s, -jnp.inf)
+                        for i, s in enumerate(scores_l)]
+        rois = jnp.concatenate(rois_l, axis=0)
+        scores = jnp.concatenate(scores_l, axis=0)
+        n = min(int(post_nms_top_n), scores.shape[0])
+        top_s, top_i = lax.top_k(scores, n)
+        return rois[top_i], top_s[:, None]
+
+    args = list(multi_rois) + list(multi_scores)
+    if rois_num_per_level is not None:
+        args.append(rois_num_per_level)
+    return apply(_collect, *args, name="collect_fpn_proposals")
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian Personalized Ranking loss (reference: loss.py:156 /
+    bpr_loss_op.cc): -mean over j != label of log sigmoid(x_label - x_j).
+    """
+
+    def _bpr(x, y):
+        B, C = x.shape
+        ids = y.astype(jnp.int32).reshape(-1)
+        pos = jnp.take_along_axis(x, ids[:, None], axis=1)
+        diff = pos - x
+        logsig = jax.nn.log_sigmoid(diff)
+        mask = jax.nn.one_hot(ids, C, dtype=x.dtype)
+        per = -jnp.sum(logsig * (1.0 - mask), axis=1) / max(C - 1, 1)
+        return per[:, None]
+
+    return apply(_bpr, input, label, name="bpr_loss")
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True, centers=None, name=None):
+    """Center loss (reference: loss.py:57 / center_loss_op.cc): pulls
+    features toward their class centers; centers update by EMA when
+    `update_center` (eager mode).
+
+    Returns (loss [N, 1], centers). Pass the returned centers back in to
+    keep state across steps (functional-state form of the reference's
+    persistable center table)."""
+    if centers is None:
+        dim = int(input.shape[-1])
+        centers = Tensor(jnp.zeros((num_classes, dim), jnp.float32))
+
+    def _cl(x, y, c):
+        ids = y.astype(jnp.int32).reshape(-1)
+        cx = jnp.take(c, ids, axis=0)
+        diff = x - cx
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        return loss, diff
+
+    loss, diff = apply(_cl, input, label, centers, name="center_loss")
+    if update_center:
+        ids = jnp.asarray(
+            (label._data if isinstance(label, Tensor) else label)
+        ).astype(jnp.int32).reshape(-1)
+        counts = jnp.zeros((centers.shape[0],), jnp.float32) \
+            .at[ids].add(1.0)
+        upd = jnp.zeros_like(centers._data).at[ids].add(
+            jnp.asarray(diff._data))
+        denom = (counts + 1.0)[:, None]
+        centers._data = centers._data + alpha * upd / denom
+    return loss, centers
+
+
+def cvm(input, cvm_input, use_cvm=True, name=None):
+    """Continuous-value model op (reference: cvm_op.cc): the first two
+    lanes are show/click; use_cvm=True keeps them log-adjusted, False
+    strips them."""
+
+    def _cvm(x, sc):
+        show = jnp.log(sc[:, :1] + 1.0)
+        click = jnp.log(sc[:, 1:2] + 1.0) - jnp.log(sc[:, :1] + 1.0)
+        if use_cvm:
+            return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+        return x[:, 2:]
+
+    return apply(_cvm, input, cvm_input, name="cvm")
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """Sinusoidal position encoding mix (reference: nn.py:13231 /
+    add_position_encoding_op.cc): out = alpha*x + beta*PE."""
+
+    def _ape(x):
+        B, S, E = x.shape
+        half = E // 2
+        pos = jnp.arange(S, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+        if pe.shape[1] < E:
+            pe = jnp.pad(pe, ((0, 0), (0, E - pe.shape[1])))
+        return alpha * x + beta * pe[None, :, :]
+
+    return apply(_ape, input, name="add_position_encoding")
+
+
+def crf_decoding(input, transition, label=None, length=None, name=None):
+    """Viterbi decode alias in the CRF naming (reference:
+    crf_decoding_op.cc): returns the best path [B, S] (and, with label,
+    a 0/1 correctness mask like the reference's evaluation mode)."""
+    from ..text.viterbi import viterbi_decode
+
+    B, S = int(input.shape[0]), int(input.shape[1])
+    if length is None:
+        length = Tensor(jnp.full((B,), S, jnp.int32))
+    scores, path = viterbi_decode(input, transition, length,
+                                  include_bos_eos_tag=False)
+    if label is not None:
+        def _cmp(p, lab):
+            return (p == lab.astype(p.dtype)).astype(jnp.int64)
+        return apply(_cmp, path, label, name="crf_decoding_eval")
+    return path

@@ -504,3 +504,14 @@ from .detection import (anchor_generator, box_coder,  # noqa: E402,F401
 
 __all__ += ["prior_box", "anchor_generator", "box_coder",
             "multiclass_nms"]
+
+from .detection_extra import (add_position_encoding,  # noqa: E402,F401
+                              bipartite_match, box_clip, bpr_loss,
+                              center_loss, collect_fpn_proposals,
+                              crf_decoding, cvm, density_prior_box,
+                              distribute_fpn_proposals)
+
+__all__ += ["bipartite_match", "box_clip", "density_prior_box",
+            "distribute_fpn_proposals", "collect_fpn_proposals",
+            "bpr_loss", "center_loss", "cvm", "add_position_encoding",
+            "crf_decoding"]

@@ -1,0 +1,108 @@
+"""Detection/ranking op tail (reference: fluid/layers/detection.py
+bipartite_match/box_clip/density_prior_box/FPN ops; loss.py
+bpr_loss/center_loss; cvm_op.cc; nn.py add_position_encoding)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_bipartite_match_greedy():
+    # classic example: greedy max matching, no duplicate rows
+    d = paddle.to_tensor(np.array([[0.1, 0.9, 0.3],
+                                   [0.8, 0.2, 0.4]], np.float32))
+    idx, dist = V.bipartite_match(d)
+    idx, dist = idx.numpy()[0], dist.numpy()[0]
+    # col1 -> row0 (0.9 best overall), col0 -> row1 (0.8), col2 unmatched
+    assert idx.tolist() == [1, 0, -1]
+    np.testing.assert_allclose(dist[:2], [0.8, 0.9], atol=1e-6)
+    # per_prediction fills unmatched cols above threshold
+    idx2, _ = V.bipartite_match(d, match_type="per_prediction",
+                                dist_threshold=0.25)
+    assert idx2.numpy()[0].tolist() == [1, 0, 1]    # col2 argmax row=1 (0.4)
+
+
+def test_box_clip():
+    boxes = paddle.to_tensor(np.array([[[-5.0, -5.0, 120.0, 80.0]]],
+                                      np.float32))
+    im_info = paddle.to_tensor(np.array([[60.0, 100.0, 1.0]], np.float32))
+    out = V.box_clip(boxes, im_info).numpy()[0, 0]
+    np.testing.assert_allclose(out, [0.0, 0.0, 99.0, 59.0])
+
+
+def test_density_prior_box_shapes_and_reference_spacing():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, var = V.density_prior_box(feat, img, densities=[2],
+                                     fixed_sizes=[16.0],
+                                     fixed_ratios=[1.0])
+    assert boxes.shape == [2, 2, 4, 4]      # 2x2 cells, 1*1*2*2 boxes
+    assert var.shape == boxes.shape
+    # reference spacing: step 32 -> sub-centers at cx -/+ step_avg/4 = 8
+    b = boxes.numpy()[0, 0] * 64.0          # cell center (16, 16)
+    centers_x = np.sort((b[:, 0] + b[:, 2]) / 2.0)
+    np.testing.assert_allclose(centers_x, [8.0, 8.0, 24.0, 24.0],
+                               atol=1e-4)
+
+
+def test_fpn_distribute_and_collect():
+    rois = paddle.to_tensor(np.array(
+        [[0, 0, 16, 16],        # scale 16 -> low level
+         [0, 0, 224, 224],      # scale 224 -> refer level
+         [0, 0, 500, 500]], np.float32))
+    multi, restore, counts = V.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    assert len(multi) == 4
+    c = counts.numpy()
+    assert c.sum() == 3 and c[0] == 1       # the 16x16 roi at min level
+    # collect: top-k by score across levels; PAD rows (beyond each
+    # level's count) must never outrank real proposals
+    scores = [paddle.to_tensor(np.full((3, 1), s, np.float32))
+              for s in (0.9, 0.8, 0.7, 0.6)]
+    out_rois, out_scores = V.collect_fpn_proposals(
+        multi, scores, 2, 5, post_nms_top_n=3,
+        rois_num_per_level=counts)
+    got = out_scores.numpy()[:, 0]
+    np.testing.assert_allclose(got, [0.9, 0.7, 0.6], atol=1e-6)
+    assert not (out_rois.numpy() == 0).all(axis=1).any()
+
+
+def test_bpr_and_center_loss_and_cvm():
+    x = paddle.to_tensor(np.array([[5.0, 0.0, 0.0]], np.float32))
+    y = paddle.to_tensor(np.array([[0]], np.int64))
+    loss = V.bpr_loss(x, y)
+    assert float(loss.numpy()) < 0.1        # label logit dominates
+
+    feats = paddle.to_tensor(np.ones((4, 8), np.float32))
+    labels = paddle.to_tensor(np.zeros((4,), np.int64))
+    l1, centers = V.center_loss(feats, labels, num_classes=3, alpha=0.5)
+    assert l1.shape == [4, 1]
+    # centers moved toward the features
+    assert float(np.abs(centers.numpy()[0]).sum()) > 0
+    l2, _ = V.center_loss(feats, labels, 3, 0.5, centers=centers)
+    assert float(l2.numpy().sum()) < float(l1.numpy().sum())
+
+    emb = paddle.to_tensor(np.ones((2, 5), np.float32))
+    sc = paddle.to_tensor(np.array([[9.0, 3.0], [1.0, 0.0]], np.float32))
+    out = V.cvm(emb, sc, use_cvm=True)
+    assert out.shape == [2, 5]
+    np.testing.assert_allclose(out.numpy()[0, 0], np.log(10.0), rtol=1e-5)
+    out2 = V.cvm(emb, sc, use_cvm=False)
+    assert out2.shape == [2, 3]
+
+
+def test_add_position_encoding_and_crf_decoding():
+    x = paddle.to_tensor(np.zeros((1, 4, 6), np.float32))
+    out = V.add_position_encoding(x, alpha=1.0, beta=1.0).numpy()
+    # PE at position 0: sin(0)=0 for first half, cos(0)=1 for second
+    np.testing.assert_allclose(out[0, 0, :3], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3:], 1.0, atol=1e-6)
+
+    rng = np.random.default_rng(0)
+    emis = paddle.to_tensor(rng.normal(size=(2, 5, 3)).astype(np.float32))
+    trans = paddle.to_tensor(rng.normal(size=(3, 3)).astype(np.float32))
+    path = V.crf_decoding(emis, trans)
+    assert path.shape == [2, 5]
+    mask = V.crf_decoding(emis, trans, label=path)
+    assert (mask.numpy() == 1).all()        # path agrees with itself
