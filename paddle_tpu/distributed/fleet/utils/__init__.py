@@ -1,0 +1,6 @@
+"""fleet.utils: recompute + filesystem transports (reference:
+python/paddle/distributed/fleet/utils/)."""
+
+from .recompute import *  # noqa: F401,F403
+from . import fs  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401

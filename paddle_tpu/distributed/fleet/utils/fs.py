@@ -1,0 +1,246 @@
+"""Filesystem clients for fleet checkpoint transport.
+
+reference parity: python/paddle/distributed/fleet/utils/fs.py —
+FS base(:57), LocalFS(:119), HDFSClient(:423, shelling out to the
+hadoop CLI). The checkpoint/elastic stack moves state through this
+interface so remote stores slot in without touching training code.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
+           "FSTimeOut", "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """Abstract transport (reference: fs.py FS:57)."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path) -> List[str]:
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None) -> str:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference: fs.py LocalFS:119)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(f"{dst_path} exists")
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(f"{src_path} does not exist")
+        shutil.move(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(f"{fs_path} exists")
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        self.mv(local_path, fs_path, overwrite=True)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """HDFS transport shelling out to the hadoop CLI (reference:
+    fs.py HDFSClient:423 — same `hadoop fs -ls/-put/-get` command
+    surface). Raises ExecuteError with the command output when the CLI
+    is absent or a command fails."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[dict] = None,
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args) -> str:
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += [f"-D{k}={v}"]
+        cmd += list(args)
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=self._timeout)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop CLI not found at {self._hadoop!r}: {e}") from e
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
+        if out.returncode != 0:
+            raise ExecuteError(
+                f"{' '.join(cmd)} failed: {out.stderr.strip()[:500]}")
+        return out.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    mv = rename
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(f"{fs_path} exists")
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)

@@ -16,8 +16,8 @@ from typing import Any
 
 import jax
 
-from ...core.tensor import Tensor, apply
-from ...nn.layer import Layer
+from ....core.tensor import Tensor, apply
+from ....nn.layer import Layer
 
 __all__ = ["recompute", "recompute_sequential"]
 
@@ -68,7 +68,7 @@ def recompute(function, *args, use_reentrant: bool = True,
 
     def pure(*raw):
         import contextlib
-        from ...jit.functional import bind
+        from ....jit.functional import bind
         per_layer = [dict() for _ in layers]
         for (li, k, _), arr in zip(p_entries, raw[:n_p]):
             per_layer[li][k] = arr

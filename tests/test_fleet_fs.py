@@ -1,0 +1,41 @@
+"""fleet.utils.fs: LocalFS + HDFSClient shell transport (reference:
+distributed/fleet/utils/fs.py)."""
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                   FSFileExistsError)
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+    f = d / "model.pdparams"
+    fs.touch(str(f))
+    assert fs.is_file(str(f))
+    with pytest.raises(FSFileExistsError):
+        fs.touch(str(f), exist_ok=False)
+    (d / "sub").mkdir()
+    dirs, files = fs.ls_dir(str(d))
+    assert dirs == ["sub"] and files == ["model.pdparams"]
+    assert fs.list_dirs(str(d)) == ["sub"]
+    f.write_text("abc")
+    assert fs.cat(str(f)) == "abc"
+    fs.mv(str(f), str(d / "renamed"), overwrite=True)
+    assert fs.is_file(str(d / "renamed"))
+    with pytest.raises(FSFileExistsError):
+        fs.touch(str(d / "renamed"))
+        fs.mv(str(d / "sub"), str(d / "renamed"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert fs.need_upload_download() is False
+
+
+def test_hdfs_client_without_cli_raises_cleanly():
+    client = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(ExecuteError, match="not found"):
+        client.upload("/tmp/x", "/remote/x")
+    assert client.need_upload_download() is True
