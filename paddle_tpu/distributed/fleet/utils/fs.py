@@ -37,6 +37,10 @@ class FSShellCmdAborted(ExecuteError):
     pass
 
 
+class _ProbeFalse(Exception):
+    """Internal: a hadoop -test probe returned 'condition false'."""
+
+
 class FS:
     """Abstract transport (reference: fs.py FS:57)."""
 
@@ -124,10 +128,14 @@ class LocalFS(FS):
         os.rename(fs_src_path, fs_dst_path)
 
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
-        if not overwrite and self.is_exist(dst_path):
-            raise FSFileExistsError(f"{dst_path} exists")
         if test_exists and not self.is_exist(src_path):
             raise FSFileNotExistsError(f"{src_path} does not exist")
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(f"{dst_path} exists")
+            # REPLACE the destination: shutil.move into an existing dir
+            # would nest the source inside it
+            self.delete(dst_path)
         shutil.move(src_path, dst_path)
 
     def touch(self, fs_path, exist_ok=True):
@@ -139,7 +147,12 @@ class LocalFS(FS):
             pass
 
     def upload(self, local_path, fs_path):
-        self.mv(local_path, fs_path, overwrite=True)
+        # COPY (like the remote transports): the caller keeps its local
+        # source — upload must never destroy the only local checkpoint
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(local_path, fs_path)
 
     def download(self, fs_path, local_path):
         shutil.copy(fs_path, local_path)
@@ -162,7 +175,12 @@ class HDFSClient(FS):
         self._configs = configs or {}
         self._timeout = time_out / 1000.0
 
-    def _run(self, *args) -> str:
+    def _run(self, *args, probe: bool = False) -> str:
+        """Run a hadoop fs command. `probe=True` is the `-test` mode:
+        return code 1 with empty stderr means "condition false" (not an
+        error) and raises _ProbeFalse; every other failure — missing CLI,
+        permissions, network — still raises, so a broken transport can
+        NEVER masquerade as "file does not exist"."""
         cmd = [self._hadoop, "fs"]
         for k, v in self._configs.items():
             cmd += [f"-D{k}={v}"]
@@ -176,6 +194,8 @@ class HDFSClient(FS):
         except subprocess.TimeoutExpired as e:
             raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
         if out.returncode != 0:
+            if probe and out.returncode == 1 and not out.stderr.strip():
+                raise _ProbeFalse()
             raise ExecuteError(
                 f"{' '.join(cmd)} failed: {out.stderr.strip()[:500]}")
         return out.stdout
@@ -184,10 +204,12 @@ class HDFSClient(FS):
         out = self._run("-ls", fs_path)
         dirs, files = [], []
         for line in out.splitlines():
-            parts = line.split()
+            # -ls format: perms repl owner group size date time path — the
+            # path (which may contain spaces) is everything after field 7
+            parts = line.split(None, 7)
             if len(parts) < 8:
                 continue
-            name = os.path.basename(parts[-1])
+            name = os.path.basename(parts[7])
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
@@ -196,23 +218,23 @@ class HDFSClient(FS):
 
     def is_exist(self, fs_path):
         try:
-            self._run("-test", "-e", fs_path)
+            self._run("-test", "-e", fs_path, probe=True)
             return True
-        except ExecuteError:
+        except _ProbeFalse:
             return False
 
     def is_file(self, fs_path):
         try:
-            self._run("-test", "-f", fs_path)
+            self._run("-test", "-f", fs_path, probe=True)
             return True
-        except ExecuteError:
+        except _ProbeFalse:
             return False
 
     def is_dir(self, fs_path):
         try:
-            self._run("-test", "-d", fs_path)
+            self._run("-test", "-d", fs_path, probe=True)
             return True
-        except ExecuteError:
+        except _ProbeFalse:
             return False
 
     def upload(self, local_path, fs_path):
@@ -233,7 +255,15 @@ class HDFSClient(FS):
     def rename(self, fs_src_path, fs_dst_path):
         self._run("-mv", fs_src_path, fs_dst_path)
 
-    mv = rename
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(f"{fs_src_path} does not exist")
+        if self.is_exist(fs_dst_path):
+            if not overwrite:
+                raise FSFileExistsError(f"{fs_dst_path} exists")
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
 
     def touch(self, fs_path, exist_ok=True):
         if self.is_exist(fs_path):

@@ -27,8 +27,19 @@ def test_localfs_roundtrip(tmp_path):
     fs.mv(str(f), str(d / "renamed"), overwrite=True)
     assert fs.is_file(str(d / "renamed"))
     with pytest.raises(FSFileExistsError):
-        fs.touch(str(d / "renamed"))
         fs.mv(str(d / "sub"), str(d / "renamed"))
+    # overwrite=True REPLACES an existing destination directory
+    (d / "sub" / "inner.txt").write_text("x")
+    (d / "dst").mkdir()
+    (d / "dst" / "stale.txt").write_text("old")
+    fs.mv(str(d / "sub"), str(d / "dst"), overwrite=True)
+    assert fs.is_file(str(d / "dst" / "inner.txt"))
+    assert not fs.is_exist(str(d / "dst" / "stale.txt"))
+    # upload COPIES (the local source survives)
+    src = d / "local.bin"
+    src.write_text("data")
+    fs.upload(str(src), str(d / "published.bin"))
+    assert fs.is_file(str(src)) and fs.is_file(str(d / "published.bin"))
     fs.delete(str(d))
     assert not fs.is_exist(str(d))
     assert fs.need_upload_download() is False
