@@ -212,3 +212,7 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
                  _t(x), name="cov")
+
+
+# reference re-exports these tensor ops through paddle.linalg too
+from .math import bmm, dot, t  # noqa: E402,F401
