@@ -1,15 +1,15 @@
 """paddle.onnx surface.
 
-reference parity: python/paddle/onnx/export.py — a thin wrapper delegating
-to the external `paddle2onnx` converter over a jit-saved inference model.
+reference parity: python/paddle/onnx/export.py — a thin wrapper over a
+program->ONNX converter (paddle2onnx in the reference).
 
-TPU-native reality: the portable interchange format for XLA-compiled
-models is StableHLO, not ONNX — `export` produces the jit.save artifact
-set (.mlir StableHLO text + .jaxexport serialized executable + params),
-which StableHLO consumers (IREE, XLA AOT, onnx-mlir's StableHLO importer)
-ingest directly. No .onnx protobuf is written (no converter is shipped);
-the function says so loudly via a warning and its return value names the
-actual artifacts, so nothing downstream can mistake the output for ONNX.
+TPU-native: `export` traces the model to a jaxpr and emits a REAL
+`.onnx` ModelProto (paddle_tpu.onnx_export: hand-written protobuf wire
+encoder + primitive mappers — verified by the bundled decoder/numpy
+runtime, since no onnx package ships in this image). Models using
+primitives without a mapping fall back to the StableHLO artifact set
+(jit.save) with a loud warning naming the unsupported primitive — a
+partial export is never silently wrong.
 """
 
 from __future__ import annotations
@@ -19,31 +19,32 @@ import warnings
 __all__ = ["export"]
 
 
-def export(layer, path: str, input_spec=None, opset_version: int = 9,
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
            **configs):
-    """Export ``layer`` for interchange (reference: onnx/export.py).
+    """Export ``layer`` (reference: onnx/export.py).
 
-    Writes the StableHLO artifact set at ``path`` (same as jit.save) and
-    returns the ``path + ".mlir"`` it actually wrote. ``opset_version``
-    and ONNX-specific ``configs`` do not apply to StableHLO and are
-    rejected when set to non-defaults, rather than silently dropped.
+    Returns the ``.onnx`` path on success. On unsupported models, writes
+    the StableHLO artifact set instead and returns ``path + ".mlir"``
+    (with a warning naming the unsupported primitive).
     """
-    from .jit.to_static import save as jit_save
+    from .onnx_export import UnsupportedOnnxExport
+    from .onnx_export import export as real_export
 
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec (static shapes)")
-    if opset_version != 9:
-        raise ValueError(
-            f"opset_version={opset_version} has no meaning for the "
-            "StableHLO export this framework produces; omit it")
     if configs:
         raise ValueError(
-            f"unsupported ONNX-specific options: {sorted(configs)} — the "
-            "export is StableHLO (.mlir/.jaxexport), not an .onnx protobuf")
-    jit_save(layer, path, input_spec=input_spec)
-    warnings.warn(
-        "paddle_tpu exports StableHLO, the XLA-native interchange format: "
-        f"wrote {path}.mlir (+ .jaxexport/.pdiparams). No .onnx protobuf "
-        "is produced; use a StableHLO->ONNX converter if you need one.",
-        stacklevel=2)
-    return path + ".mlir"
+            f"unsupported ONNX-specific options: {sorted(configs)}")
+    try:
+        return real_export(layer, path, input_spec=input_spec,
+                           opset_version=opset_version)
+    except UnsupportedOnnxExport as e:
+        from .jit.to_static import save as jit_save
+        jit_save(layer, path, input_spec=input_spec)
+        warnings.warn(
+            f"ONNX export unsupported for this model ({e}); wrote the "
+            f"StableHLO artifact set instead: {path}.mlir "
+            "(+ .jaxexport/.pdiparams) — the XLA-native interchange "
+            "format that StableHLO consumers (IREE, XLA AOT) ingest.",
+            stacklevel=2)
+        return path + ".mlir"

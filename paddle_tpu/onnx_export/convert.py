@@ -1,0 +1,283 @@
+"""jaxpr -> ONNX graph conversion.
+
+reference parity: paddle.onnx.export (reference: python/paddle/onnx/
+export.py, delegating to paddle2onnx's program->ONNX op mappers).
+
+TPU-native redesign: the model is traced to a jaxpr (the same IR every
+jitted path uses) and each supported primitive maps to ONNX nodes —
+`dot_general` to MatMul/Transpose compositions, `conv_general_dilated`
+to Conv, elementwise/reduction/shape primitives to their operators,
+pjit/custom_jvp sub-jaxprs inlined recursively. Unsupported primitives
+raise, naming the culprit — a partial export is never silently wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["jaxpr_to_onnx", "UnsupportedOnnxExport"]
+
+
+class UnsupportedOnnxExport(NotImplementedError):
+    pass
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.counter = 0
+        self.names: Dict[int, str] = {}   # id(jax var) -> onnx name
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.add_const(np.asarray(var.val))
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    def add_const(self, arr: np.ndarray, hint="const"):
+        name = self.fresh(hint)
+        arr = np.asarray(arr)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        if arr.dtype == np.bool_:
+            arr = arr.astype(np.int64)
+        dt = proto.NP_TO_ONNX[str(arr.dtype)]
+        self.initializers.append(proto.tensor_proto(
+            name, arr.shape, dt, np.ascontiguousarray(arr).tobytes()))
+        return name
+
+    def emit(self, op, inputs, n_out=1, attributes=(), hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node_proto(op, inputs, outs,
+                                           attributes=list(attributes)))
+        return outs[0] if n_out == 1 else outs
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "neg": "Neg", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos",
+    "eq": "Equal", "gt": "Greater", "lt": "Less",
+    "ge": "GreaterOrEqual", "le": "LessOrEqual",
+}
+
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+           "reduce_min": "ReduceMin"}
+
+
+def _handle_dot_general(b: _Builder, eqn, invals):
+    dn = eqn.params["dimension_numbers"]
+    (lc, rc), (lb, rb) = dn
+    lhs, rhs = eqn.invars
+    l_nd, r_nd = len(lhs.aval.shape), len(rhs.aval.shape)
+    lname, rname = invals
+    if lc == (l_nd - 1,) and rc == (len(lb),) and \
+            lb == tuple(range(len(lb))) and rb == tuple(range(len(rb))):
+        # x[..., k] . w[*batch, k, n]: ONNX MatMul semantics directly
+        return b.emit("MatMul", [lname, rname])
+    if not lb and not rb and lc == (l_nd - 1,) and rc == (r_nd - 1,) \
+            and r_nd == 2:
+        # x[..., k] . w[n, k]: transpose the weight then MatMul
+        wt = b.emit("Transpose", [rname],
+                    attributes=[proto.attr_ints("perm", [1, 0])])
+        return b.emit("MatMul", [lname, wt])
+    raise UnsupportedOnnxExport(
+        f"dot_general with dimension_numbers {dn} has no ONNX mapping yet")
+
+
+def _handle_conv(b: _Builder, eqn, invals):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if tuple(dn.lhs_spec) != tuple(range(len(dn.lhs_spec))) or \
+            tuple(dn.rhs_spec) != tuple(range(len(dn.rhs_spec))):
+        raise UnsupportedOnnxExport(
+            "conv export supports NCHW/OIHW-style dimension specs only")
+    if any(d != 1 for d in p.get("lhs_dilation", ())):
+        raise UnsupportedOnnxExport("transposed conv export not supported")
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    attrs = [proto.attr_ints("strides", p["window_strides"]),
+             proto.attr_ints("pads", pads),
+             proto.attr_ints("dilations", p["rhs_dilation"]),
+             proto.attr_int("group", p["feature_group_count"])]
+    return b.emit("Conv", invals, attributes=attrs)
+
+
+def _inner_closed(eqn):
+    for key in ("call_jaxpr", "jaxpr"):
+        inner = eqn.params.get(key)
+        if inner is not None:
+            if hasattr(inner, "jaxpr"):      # ClosedJaxpr
+                return inner.jaxpr, list(inner.consts)
+            return inner, []
+    return None, None
+
+
+def _convert_eqns(b: _Builder, eqns):
+    for eqn in eqns:
+        prim = eqn.primitive.name
+        if prim in ("pjit", "jit", "custom_jvp_call", "custom_vjp_call",
+                    "closed_call", "core_call", "xla_call",
+                    "remat", "checkpoint", "remat2"):
+            ij, consts = _inner_closed(eqn)
+            if ij is None:
+                raise UnsupportedOnnxExport(f"{prim} without inner jaxpr")
+            invals = [b.name_of(v) for v in eqn.invars]
+            for cv, ca in zip(ij.constvars, consts):
+                b.names[id(cv)] = b.add_const(np.asarray(ca), hint="c")
+            for iv, nm in zip(ij.invars, invals):
+                b.names[id(iv)] = nm
+            _convert_eqns(b, ij.eqns)
+            for outer_ov, ov in zip(eqn.outvars, ij.outvars):
+                b.names[id(outer_ov)] = b.name_of(ov)
+            continue
+
+        invals = [b.name_of(v) for v in eqn.invars]
+        if prim in _ELEMENTWISE:
+            out = b.emit(_ELEMENTWISE[prim], invals)
+        elif prim == "rsqrt":
+            s = b.emit("Sqrt", invals)
+            one = b.add_const(np.asarray(1.0, np.float32))
+            out = b.emit("Div", [one, s])
+        elif prim == "integer_pow":
+            e = b.add_const(np.asarray(float(eqn.params["y"]), np.float32))
+            out = b.emit("Pow", [invals[0], e])
+        elif prim == "dot_general":
+            out = _handle_dot_general(b, eqn, invals)
+        elif prim == "conv_general_dilated":
+            out = _handle_conv(b, eqn, invals)
+        elif prim in ("reshape", "squeeze", "expand_dims"):
+            shape = b.add_const(np.asarray(eqn.outvars[0].aval.shape,
+                                           np.int64))
+            out = b.emit("Reshape", [invals[0], shape])
+        elif prim == "transpose":
+            out = b.emit("Transpose", invals, attributes=[
+                proto.attr_ints("perm", eqn.params["permutation"])])
+        elif prim == "broadcast_in_dim":
+            tgt = eqn.outvars[0].aval.shape
+            bdims = eqn.params["broadcast_dimensions"]
+            in_shape = eqn.invars[0].aval.shape
+            inter = [1] * len(tgt)
+            for i, d in enumerate(bdims):
+                inter[d] = in_shape[i]
+            if tuple(eqn.invars[0].aval.shape) == ():
+                inter = [1] * max(len(tgt), 1)
+            rs = b.add_const(np.asarray(inter, np.int64))
+            mid = b.emit("Reshape", [invals[0], rs])
+            shp = b.add_const(np.asarray(tgt if tgt else (1,), np.int64))
+            out = b.emit("Expand", [mid, shp])
+            if not tgt:
+                out = b.emit("Reshape", [out, b.add_const(
+                    np.asarray([], np.int64))])
+        elif prim == "reduce_sum":
+            # ReduceSum-13 takes axes as an INPUT
+            axes = b.add_const(np.asarray(eqn.params["axes"], np.int64))
+            out = b.emit("ReduceSum", [invals[0], axes], attributes=[
+                proto.attr_int("keepdims", 0)])
+        elif prim in ("reduce_max", "reduce_min"):
+            # ReduceMax/Min-13 take axes as an ATTRIBUTE (input form is
+            # opset 18+)
+            out = b.emit(_REDUCE[prim], [invals[0]], attributes=[
+                proto.attr_ints("axes", eqn.params["axes"]),
+                proto.attr_int("keepdims", 0)])
+        elif prim == "convert_element_type":
+            tdt = proto.NP_TO_ONNX[str(np.dtype(eqn.params["new_dtype"]))]
+            out = b.emit("Cast", invals,
+                         attributes=[proto.attr_int("to", tdt)])
+        elif prim == "select_n":
+            if len(invals) != 3:
+                raise UnsupportedOnnxExport(
+                    f"select_n with {len(invals) - 1} cases (only the "
+                    "binary predicate form maps to ONNX Where)")
+            cond = b.emit("Cast", [invals[0]], attributes=[
+                proto.attr_int("to", proto.BOOL)])
+            out = b.emit("Where", [cond, invals[2], invals[1]])
+        elif prim in ("stop_gradient", "copy"):
+            out = b.emit("Identity", invals)
+        elif prim in ("reduce_window_max", "reduce_window_sum"):
+            # pooling windows over NCHW: window/strides are all-1 on the
+            # leading batch/channel dims
+            wd = eqn.params["window_dimensions"]
+            ws = eqn.params["window_strides"]
+            pad = eqn.params["padding"]
+            if tuple(wd[:2]) != (1, 1) or tuple(ws[:2]) != (1, 1):
+                raise UnsupportedOnnxExport(
+                    "reduce_window export needs NCHW pooling windows")
+            kwargs = [proto.attr_ints("kernel_shape", wd[2:]),
+                      proto.attr_ints("strides", ws[2:]),
+                      proto.attr_ints("pads",
+                                      [lo for lo, _ in pad[2:]]
+                                      + [hi for _, hi in pad[2:]])]
+            if prim == "reduce_window_max":
+                out = b.emit("MaxPool", [invals[0]], attributes=kwargs)
+            else:
+                # sum window = AveragePool * window_size;
+                # count_include_pad=1 so padded borders divide by the FULL
+                # window (matching the sum semantics)
+                kwargs = kwargs + [proto.attr_int("count_include_pad", 1)]
+                out = b.emit("AveragePool", [invals[0]], attributes=kwargs)
+                scale = b.add_const(np.asarray(
+                    float(np.prod(wd)), np.float32))
+                out = b.emit("Mul", [out, scale])
+        elif prim == "concatenate":
+            out = b.emit("Concat", invals, attributes=[
+                proto.attr_int("axis", eqn.params["dimension"])])
+        else:
+            raise UnsupportedOnnxExport(
+                f"primitive {prim!r} has no ONNX mapping; supported: "
+                f"{sorted(_ELEMENTWISE)} + dot_general/"
+                "conv_general_dilated/reshape/transpose/broadcast_in_dim/"
+                "reduce_(sum|max|min)/convert_element_type/select_n/"
+                "concatenate (+ pjit/custom-call inlining)")
+        b.names[id(eqn.outvars[0])] = out
+        if len(eqn.outvars) > 1:
+            raise UnsupportedOnnxExport(
+                f"multi-output primitive {prim!r} unsupported")
+
+
+def jaxpr_to_onnx(closed_jaxpr, input_names, consts, graph_name="model",
+                  opset=13):
+    """Convert a closed jaxpr to ONNX ModelProto bytes.
+
+    input_names: names for the leading jaxpr invars that are GRAPH
+    inputs (same order); remaining invars are weights whose arrays come
+    from `consts` (aligned) and become initializers.
+    """
+    jaxpr = closed_jaxpr.jaxpr
+    b = _Builder()
+
+    graph_inputs = []
+    for var, name in zip(jaxpr.invars[:len(input_names)], input_names):
+        b.names[id(var)] = name
+        dt = proto.NP_TO_ONNX[str(var.aval.dtype)]
+        graph_inputs.append(proto.value_info(name, dt, var.aval.shape))
+    for var, arr in zip(jaxpr.invars[len(input_names):], consts):
+        b.names[id(var)] = b.add_const(np.asarray(arr), hint="w")
+    for var, arr in zip(jaxpr.constvars, closed_jaxpr.consts):
+        b.names[id(var)] = b.add_const(np.asarray(arr), hint="c")
+
+    _convert_eqns(b, jaxpr.eqns)
+
+    graph_outputs = []
+    for var in jaxpr.outvars:
+        nm = b.name_of(var)
+        dt = proto.NP_TO_ONNX[str(var.aval.dtype)]
+        graph_outputs.append(proto.value_info(nm, dt, var.aval.shape))
+
+    graph = proto.graph_proto(b.nodes, graph_name, b.initializers,
+                              graph_inputs, graph_outputs)
+    return proto.model_proto(graph, opset_version=opset)

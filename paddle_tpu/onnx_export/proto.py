@@ -1,0 +1,166 @@
+"""Minimal protobuf wire-format encoder/decoder for ONNX messages.
+
+The image ships no `onnx` package, so the exporter emits the wire bytes
+directly against the onnx.proto schema (field numbers below mirror
+https://github.com/onnx/onnx/blob/main/onnx/onnx.proto). The decoder
+exists so tests can round-trip and EXECUTE exported graphs without any
+external dependency.
+
+Wire format: each field is a varint key ``(field_number << 3) | type``
+with type 0 = varint, 2 = length-delimited, 5 = 32-bit.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+# ---------------------------------------------------------------------------
+# low-level wire helpers
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(int(value))
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def field_string(num: int, s: str) -> bytes:
+    return field_bytes(num, s.encode("utf-8"))
+
+
+def field_float(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", float(value))
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_message(buf: bytes) -> Dict[int, List]:
+    """Decode one message into {field_number: [raw values]} — varints as
+    ints, length-delimited as bytes, 32-bit as raw 4 bytes."""
+    fields: Dict[int, List] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = read_varint(buf, pos)
+        num, wtype = key >> 3, key & 7
+        if wtype == 0:
+            val, pos = read_varint(buf, pos)
+        elif wtype == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wtype == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        fields.setdefault(num, []).append(val)
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# ONNX message field numbers (onnx.proto)
+# ---------------------------------------------------------------------------
+
+# TensorProto.DataType
+FLOAT, INT32, INT64, BOOL, FLOAT16, BFLOAT16, DOUBLE = 1, 6, 7, 9, 10, 16, 11
+
+NP_TO_ONNX = {
+    "float32": FLOAT, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "bfloat16": BFLOAT16, "float64": DOUBLE,
+}
+
+
+def tensor_proto(name: str, dims, data_type: int, raw: bytes) -> bytes:
+    out = b"".join(field_varint(1, d) for d in dims)
+    out += field_varint(2, data_type)
+    out += field_string(8, name)
+    out += field_bytes(9, raw)
+    return out
+
+
+def attr_int(name: str, value: int) -> bytes:
+    return field_string(1, name) + field_varint(3, value) \
+        + field_varint(20, 2)                     # AttributeProto.INT
+
+
+def attr_float(name: str, value: float) -> bytes:
+    return field_string(1, name) + field_float(2, value) \
+        + field_varint(20, 1)                     # AttributeProto.FLOAT
+
+
+def attr_ints(name: str, values) -> bytes:
+    out = field_string(1, name)
+    for v in values:
+        out += field_varint(8, v)
+    out += field_varint(20, 7)                    # AttributeProto.INTS
+    return out
+
+
+def node_proto(op_type: str, inputs, outputs, name: str = "",
+               attributes=()) -> bytes:
+    """attributes: iterable of encoded AttributeProto payloads."""
+    out = b"".join(field_string(1, i) for i in inputs)
+    out += b"".join(field_string(2, o) for o in outputs)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    out += b"".join(field_bytes(5, a) for a in attributes)
+    return out
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    dims = b"".join(field_bytes(1, field_varint(1, d)) for d in shape)
+    shape_proto = dims
+    tensor_type = field_varint(1, elem_type) + field_bytes(2, shape_proto)
+    type_proto = field_bytes(1, tensor_type)
+    return field_string(1, name) + field_bytes(2, type_proto)
+
+
+def graph_proto(nodes, name, initializers, inputs, outputs) -> bytes:
+    out = b"".join(field_bytes(1, n) for n in nodes)
+    out += field_string(2, name)
+    out += b"".join(field_bytes(5, t) for t in initializers)
+    out += b"".join(field_bytes(11, i) for i in inputs)
+    out += b"".join(field_bytes(12, o) for o in outputs)
+    return out
+
+
+def model_proto(graph: bytes, opset_version: int = 13,
+                producer: str = "paddle_tpu") -> bytes:
+    opset = field_string(1, "") + field_varint(2, opset_version)
+    out = field_varint(1, 8)                      # ir_version 8
+    out += field_string(2, producer)
+    out += field_bytes(7, graph)
+    out += field_bytes(8, opset)
+    return out

@@ -1,0 +1,222 @@
+"""Dependency-free ONNX graph decoder + numpy executor.
+
+Exists so the exporter is VERIFIABLE in this environment (no `onnx` /
+`onnxruntime` packages): tests decode the emitted ModelProto bytes with
+the same wire rules and execute the graph with numpy, comparing against
+the source model's outputs. It doubles as a reference consumer showing
+the emitted files are structurally sound.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["OnnxModel", "load_model", "run_model"]
+
+import ml_dtypes
+
+_ONNX_TO_NP = {proto.FLOAT: np.float32, proto.INT32: np.int32,
+               proto.INT64: np.int64, proto.BOOL: np.bool_,
+               proto.DOUBLE: np.float64, proto.FLOAT16: np.float16,
+               proto.BFLOAT16: np.dtype(ml_dtypes.bfloat16)}
+
+
+def _string(v: bytes) -> str:
+    return v.decode("utf-8")
+
+
+def _parse_tensor(buf: bytes):
+    f = proto.parse_message(buf)
+    dims = [int(d) for d in f.get(1, [])]
+    dtype = _ONNX_TO_NP[int(f[2][0])]
+    name = _string(f[8][0])
+    raw = f.get(9, [b""])[0]
+    arr = np.frombuffer(raw, dtype=dtype).reshape(dims).copy()
+    return name, arr
+
+
+def _parse_attr(buf: bytes):
+    f = proto.parse_message(buf)
+    name = _string(f[1][0])
+    atype = int(f.get(20, [0])[0])
+    if atype == 1:                       # FLOAT
+        import struct
+        return name, struct.unpack("<f", f[2][0])[0]
+    if atype == 2:                       # INT
+        v = int(f[3][0])
+        return name, v - (1 << 64) if v >= 1 << 63 else v
+    if atype == 7:                       # INTS
+        return name, [int(v) for v in f.get(8, [])]
+    raise ValueError(f"attr {name}: unsupported type {atype}")
+
+
+class _Node:
+    def __init__(self, buf: bytes):
+        f = proto.parse_message(buf)
+        self.inputs = [_string(v) for v in f.get(1, [])]
+        self.outputs = [_string(v) for v in f.get(2, [])]
+        self.op = _string(f[4][0])
+        self.attrs = dict(_parse_attr(a) for a in f.get(5, []))
+
+
+class OnnxModel:
+    def __init__(self, buf: bytes):
+        m = proto.parse_message(buf)
+        self.ir_version = int(m[1][0])
+        g = proto.parse_message(m[7][0])
+        self.graph_name = _string(g[2][0])
+        self.nodes = [_Node(n) for n in g.get(1, [])]
+        self.initializers = dict(_parse_tensor(t) for t in g.get(5, []))
+        self.inputs = [self._vi_name(v) for v in g.get(11, [])]
+        self.outputs = [self._vi_name(v) for v in g.get(12, [])]
+        opset = proto.parse_message(m[8][0])
+        self.opset = int(opset[2][0])
+
+    @staticmethod
+    def _vi_name(buf: bytes) -> str:
+        return _string(proto.parse_message(buf)[1][0])
+
+
+def load_model(path: str) -> OnnxModel:
+    with open(path, "rb") as f:
+        return OnnxModel(f.read())
+
+
+def _np_conv(x, w, strides, pads, dilations, group):
+    n_sp = x.ndim - 2
+    pad_lo, pad_hi = pads[:n_sp], pads[n_sp:]
+    x = np.pad(x, [(0, 0), (0, 0)] + [(lo, hi)
+                                      for lo, hi in zip(pad_lo, pad_hi)])
+    N, C = x.shape[:2]
+    O, I = w.shape[:2]
+    ks = w.shape[2:]
+    eff = [(k - 1) * d + 1 for k, d in zip(ks, dilations)]
+    out_sp = [(x.shape[2 + i] - eff[i]) // strides[i] + 1
+              for i in range(n_sp)]
+    out = np.zeros((N, O) + tuple(out_sp), np.float32)
+    cg = C // group
+    og = O // group
+    for g in range(group):
+        xs = x[:, g * cg:(g + 1) * cg]
+        ws = w[g * og:(g + 1) * og]
+        for idx in np.ndindex(*out_sp):
+            starts = [idx[i] * strides[i] for i in range(n_sp)]
+            sl = tuple(slice(starts[i], starts[i] + eff[i], dilations[i])
+                       for i in range(n_sp))
+            patch = xs[(slice(None), slice(None)) + sl]
+            ax = list(range(1, patch.ndim))
+            out[(slice(None), slice(g * og, (g + 1) * og)) + idx] = \
+                np.tensordot(patch, ws, axes=(ax, ax))
+    return out
+
+
+def run_model(model: OnnxModel, feeds: Dict[str, np.ndarray]) -> List:
+    env = dict(model.initializers)
+    env.update({k: np.asarray(v) for k, v in feeds.items()})
+    for node in model.nodes:
+        i = [env[n] for n in node.inputs]
+        op = node.op
+        if op == "MatMul":
+            out = np.matmul(i[0], i[1])
+        elif op == "Add":
+            out = i[0] + i[1]
+        elif op == "Sub":
+            out = i[0] - i[1]
+        elif op == "Mul":
+            out = i[0] * i[1]
+        elif op == "Div":
+            out = i[0] / i[1]
+        elif op == "Pow":
+            out = np.power(i[0], i[1])
+        elif op == "Max":
+            out = np.maximum(i[0], i[1])
+        elif op == "Min":
+            out = np.minimum(i[0], i[1])
+        elif op in ("Exp", "Log", "Tanh", "Sqrt", "Abs", "Sign", "Floor",
+                    "Ceil", "Sin", "Cos"):
+            out = getattr(np, op.lower())(i[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-i[0]))
+        elif op == "Erf":
+            from math import erf
+            out = np.vectorize(erf)(i[0]).astype(i[0].dtype)
+        elif op == "Neg":
+            out = -i[0]
+        elif op == "Equal":
+            out = i[0] == i[1]
+        elif op == "Greater":
+            out = i[0] > i[1]
+        elif op == "Less":
+            out = i[0] < i[1]
+        elif op == "GreaterOrEqual":
+            out = i[0] >= i[1]
+        elif op == "LessOrEqual":
+            out = i[0] <= i[1]
+        elif op == "Transpose":
+            out = np.transpose(i[0], node.attrs["perm"])
+        elif op == "Reshape":
+            out = i[0].reshape([int(d) for d in i[1]])
+        elif op == "Expand":
+            out = np.broadcast_to(i[0], [int(d) for d in i[1]]).copy()
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin"):
+            fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                  "ReduceMin": np.min}[op]
+            # ReduceSum-13 carries axes as input; ReduceMax/Min-13 as attr
+            if len(i) > 1:
+                axes = tuple(int(a) for a in i[1])
+            else:
+                axes = tuple(node.attrs["axes"])
+            out = fn(i[0], axis=axes,
+                     keepdims=bool(node.attrs.get("keepdims", 1)))
+        elif op == "Cast":
+            out = i[0].astype(_ONNX_TO_NP[node.attrs["to"]])
+        elif op == "Where":
+            out = np.where(i[0].astype(bool), i[1], i[2])
+        elif op == "Identity":
+            out = i[0]
+        elif op == "Concat":
+            out = np.concatenate(i, axis=node.attrs["axis"])
+        elif op in ("MaxPool", "AveragePool"):
+            ks = node.attrs["kernel_shape"]
+            strides = node.attrs["strides"]
+            pads = node.attrs["pads"]
+            n_sp = len(ks)
+            x = i[0]
+            pad_lo, pad_hi = pads[:n_sp], pads[n_sp:]
+            fill = -np.inf if op == "MaxPool" else 0.0
+            x = np.pad(x, [(0, 0), (0, 0)] + list(zip(pad_lo, pad_hi)),
+                       constant_values=fill)
+            out_sp = [(x.shape[2 + k] - ks[k]) // strides[k] + 1
+                      for k in range(n_sp)]
+            out = np.zeros(x.shape[:2] + tuple(out_sp), np.float32)
+            for idx in np.ndindex(*out_sp):
+                sl = tuple(slice(idx[k] * strides[k],
+                                 idx[k] * strides[k] + ks[k])
+                           for k in range(n_sp))
+                patch = x[(slice(None), slice(None)) + sl]
+                red = patch.reshape(patch.shape[:2] + (-1,))
+                if op == "MaxPool":
+                    val = red.max(-1)
+                elif node.attrs.get("count_include_pad", 0):
+                    val = red.mean(-1)
+                else:
+                    raise NotImplementedError(
+                        "AveragePool without count_include_pad")
+                out[(slice(None), slice(None)) + idx] = val
+        elif op == "Conv":
+            out = _np_conv(i[0].astype(np.float32),
+                           i[1].astype(np.float32),
+                           node.attrs["strides"], node.attrs["pads"],
+                           node.attrs["dilations"],
+                           node.attrs.get("group", 1))
+            if len(i) > 2:
+                bias = i[2].reshape((1, -1) + (1,) * (out.ndim - 2))
+                out = out + bias
+        else:
+            raise NotImplementedError(f"runtime op {op}")
+        env[node.outputs[0]] = out
+    return [env[n] for n in model.outputs]
