@@ -26,12 +26,23 @@ class UnsupportedOnnxExport(NotImplementedError):
     pass
 
 
+def _onnx_dtype(dtype) -> int:
+    key = str(np.dtype(dtype)) if not str(dtype) in proto.NP_TO_ONNX \
+        else str(dtype)
+    try:
+        return proto.NP_TO_ONNX[key]
+    except KeyError:
+        raise UnsupportedOnnxExport(
+            f"dtype {dtype} has no ONNX mapping") from None
+
+
 class _Builder:
     def __init__(self):
         self.nodes: List[bytes] = []
         self.initializers: List[bytes] = []
         self.counter = 0
         self.names: Dict[int, str] = {}   # id(jax var) -> onnx name
+        self._literal_cache: Dict = {}
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -40,7 +51,11 @@ class _Builder:
     def name_of(self, var):
         from jax._src.core import Literal
         if isinstance(var, Literal):
-            return self.add_const(np.asarray(var.val))
+            arr = np.asarray(var.val)
+            ck = (str(arr.dtype), arr.shape, arr.tobytes())
+            if ck not in self._literal_cache:
+                self._literal_cache[ck] = self.add_const(arr)
+            return self._literal_cache[ck]
         key = id(var)
         if key not in self.names:
             self.names[key] = self.fresh("v")
@@ -53,7 +68,7 @@ class _Builder:
             arr = arr.astype(np.float32)
         if arr.dtype == np.bool_:
             arr = arr.astype(np.int64)
-        dt = proto.NP_TO_ONNX[str(arr.dtype)]
+        dt = _onnx_dtype(arr.dtype)
         self.initializers.append(proto.tensor_proto(
             name, arr.shape, dt, np.ascontiguousarray(arr).tobytes()))
         return name
@@ -86,9 +101,11 @@ def _handle_dot_general(b: _Builder, eqn, invals):
     lhs, rhs = eqn.invars
     l_nd, r_nd = len(lhs.aval.shape), len(rhs.aval.shape)
     lname, rname = invals
-    if lc == (l_nd - 1,) and rc == (len(lb),) and \
-            lb == tuple(range(len(lb))) and rb == tuple(range(len(rb))):
+    if lc == (l_nd - 1,) and rc == (len(lb),) and r_nd == len(lb) + 2 \
+            and lb == tuple(range(len(lb))) and \
+            rb == tuple(range(len(rb))):
         # x[..., k] . w[*batch, k, n]: ONNX MatMul semantics directly
+        # (rhs must be exactly batch+2-D, else the general path below)
         return b.emit("MatMul", [lname, rname])
     if not lb and not rb and lc == (l_nd - 1,) and rc == (r_nd - 1,) \
             and r_nd == 2:
@@ -96,8 +113,31 @@ def _handle_dot_general(b: _Builder, eqn, invals):
         wt = b.emit("Transpose", [rname],
                     attributes=[proto.attr_ints("perm", [1, 0])])
         return b.emit("MatMul", [lname, wt])
-    raise UnsupportedOnnxExport(
-        f"dot_general with dimension_numbers {dn} has no ONNX mapping yet")
+    # general case: permute to [batch..., M, K] x [batch..., K, N],
+    # flatten multi-dim frees/contractions, MatMul, reshape back.
+    # dot_general's output order IS (batch, lhs_free, rhs_free).
+    l_free = [d for d in range(l_nd) if d not in lc and d not in lb]
+    r_free = [d for d in range(r_nd) if d not in rc and d not in rb]
+    l_shape = lhs.aval.shape
+    r_shape = rhs.aval.shape
+    batch = [l_shape[d] for d in lb]
+    M = int(np.prod([l_shape[d] for d in l_free])) if l_free else 1
+    K = int(np.prod([l_shape[d] for d in lc]))
+    N = int(np.prod([r_shape[d] for d in r_free])) if r_free else 1
+
+    lp = b.emit("Transpose", [lname], attributes=[
+        proto.attr_ints("perm", list(lb) + l_free + list(lc))])
+    lp = b.emit("Reshape", [lp, b.add_const(
+        np.asarray(batch + [M, K], np.int64))])
+    rp = b.emit("Transpose", [rname], attributes=[
+        proto.attr_ints("perm", list(rb) + list(rc) + r_free)])
+    rp = b.emit("Reshape", [rp, b.add_const(
+        np.asarray(batch + [K, N], np.int64))])
+    mm = b.emit("MatMul", [lp, rp])
+    out_shape = batch + [l_shape[d] for d in l_free] \
+        + [r_shape[d] for d in r_free]
+    return b.emit("Reshape", [mm, b.add_const(
+        np.asarray(out_shape, np.int64))])
 
 
 def _handle_conv(b: _Builder, eqn, invals):
@@ -109,12 +149,39 @@ def _handle_conv(b: _Builder, eqn, invals):
             "conv export supports NCHW/OIHW-style dimension specs only")
     if any(d != 1 for d in p.get("lhs_dilation", ())):
         raise UnsupportedOnnxExport("transposed conv export not supported")
+    if p.get("batch_group_count", 1) != 1:
+        raise UnsupportedOnnxExport(
+            "conv with batch_group_count != 1 not supported")
     pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
     attrs = [proto.attr_ints("strides", p["window_strides"]),
              proto.attr_ints("pads", pads),
              proto.attr_ints("dilations", p["rhs_dilation"]),
              proto.attr_int("group", p["feature_group_count"])]
     return b.emit("Conv", invals, attributes=attrs)
+
+
+def _handle_gather(b: _Builder, eqn, invals):
+    """Embedding-style gather (jnp.take along axis 0): operand [V, ...]
+    indexed by integer ids -> ONNX Gather(axis=0). Other gather forms
+    raise (the exporter's supported subset is explicit)."""
+    dn = eqn.params["dimension_numbers"]
+    operand = eqn.invars[0].aval
+    ss = tuple(eqn.params["slice_sizes"])
+    full_rest = tuple(operand.shape[1:])
+    if tuple(dn.start_index_map) == (0,) and \
+            tuple(dn.collapsed_slice_dims) == (0,) and \
+            ss == (1,) + full_rest:
+        idx_aval = eqn.invars[1].aval
+        # indices arrive as [..., 1]; drop the trailing index-vector dim
+        idx = invals[1]
+        if idx_aval.shape and idx_aval.shape[-1] == 1:
+            idx = b.emit("Reshape", [idx, b.add_const(
+                np.asarray(idx_aval.shape[:-1], np.int64))])
+        return b.emit("Gather", [invals[0], idx],
+                      attributes=[proto.attr_int("axis", 0)])
+    raise UnsupportedOnnxExport(
+        f"gather with dimension_numbers {dn} / slice_sizes {ss} has no "
+        "ONNX mapping (only axis-0 embedding-style gathers export)")
 
 
 def _inner_closed(eqn):
@@ -149,12 +216,45 @@ def _convert_eqns(b: _Builder, eqns):
         invals = [b.name_of(v) for v in eqn.invars]
         if prim in _ELEMENTWISE:
             out = b.emit(_ELEMENTWISE[prim], invals)
+        elif prim == "erfc":
+            e = b.emit("Erf", invals)
+            one = b.add_const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
+            out = b.emit("Sub", [one, e])
+        elif prim == "square":
+            out = b.emit("Mul", [invals[0], invals[0]])
+        elif prim == "slice":
+            starts = eqn.params["start_indices"]
+            limits = eqn.params["limit_indices"]
+            strides = eqn.params["strides"] or [1] * len(starts)
+            axes = list(range(len(starts)))
+            out = b.emit("Slice", [
+                invals[0],
+                b.add_const(np.asarray(starts, np.int64)),
+                b.add_const(np.asarray(limits, np.int64)),
+                b.add_const(np.asarray(axes, np.int64)),
+                b.add_const(np.asarray(strides, np.int64))])
+        elif prim == "gather":
+            out = _handle_gather(b, eqn, invals)
+        elif prim == "iota":
+            # static shape: bake the index grid as an initializer
+            shape = eqn.outvars[0].aval.shape
+            d = eqn.params["dimension"]
+            view = [1] * len(shape)
+            view[d] = shape[d]
+            grid = np.broadcast_to(
+                np.arange(shape[d]).reshape(view), shape)
+            out = b.add_const(np.ascontiguousarray(grid).astype(
+                np.dtype(eqn.outvars[0].aval.dtype)))
         elif prim == "rsqrt":
             s = b.emit("Sqrt", invals)
-            one = b.add_const(np.asarray(1.0, np.float32))
+            one = b.add_const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
             out = b.emit("Div", [one, s])
         elif prim == "integer_pow":
-            e = b.add_const(np.asarray(float(eqn.params["y"]), np.float32))
+            e = b.add_const(np.asarray(
+                float(eqn.params["y"]),
+                np.dtype(eqn.invars[0].aval.dtype)))
             out = b.emit("Pow", [invals[0], e])
         elif prim == "dot_general":
             out = _handle_dot_general(b, eqn, invals)
@@ -195,7 +295,7 @@ def _convert_eqns(b: _Builder, eqns):
                 proto.attr_ints("axes", eqn.params["axes"]),
                 proto.attr_int("keepdims", 0)])
         elif prim == "convert_element_type":
-            tdt = proto.NP_TO_ONNX[str(np.dtype(eqn.params["new_dtype"]))]
+            tdt = _onnx_dtype(eqn.params["new_dtype"])
             out = b.emit("Cast", invals,
                          attributes=[proto.attr_int("to", tdt)])
         elif prim == "select_n":
@@ -214,9 +314,16 @@ def _convert_eqns(b: _Builder, eqns):
             wd = eqn.params["window_dimensions"]
             ws = eqn.params["window_strides"]
             pad = eqn.params["padding"]
-            if tuple(wd[:2]) != (1, 1) or tuple(ws[:2]) != (1, 1):
+            wdl = eqn.params.get("window_dilation",
+                                 (1,) * len(wd))
+            bdl = eqn.params.get("base_dilation", (1,) * len(wd))
+            if tuple(wd[:2]) != (1, 1) or tuple(ws[:2]) != (1, 1) or \
+                    any(p_ != (0, 0) for p_ in pad[:2]) or \
+                    any(d != 1 for d in wdl) or \
+                    any(d != 1 for d in bdl):
                 raise UnsupportedOnnxExport(
-                    "reduce_window export needs NCHW pooling windows")
+                    "reduce_window export needs plain NCHW pooling "
+                    "windows (no dilation, no batch/channel padding)")
             kwargs = [proto.attr_ints("kernel_shape", wd[2:]),
                       proto.attr_ints("strides", ws[2:]),
                       proto.attr_ints("pads",
@@ -231,7 +338,8 @@ def _convert_eqns(b: _Builder, eqns):
                 kwargs = kwargs + [proto.attr_int("count_include_pad", 1)]
                 out = b.emit("AveragePool", [invals[0]], attributes=kwargs)
                 scale = b.add_const(np.asarray(
-                    float(np.prod(wd)), np.float32))
+                    float(np.prod(wd)),
+                    np.dtype(eqn.invars[0].aval.dtype)))
                 out = b.emit("Mul", [out, scale])
         elif prim == "concatenate":
             out = b.emit("Concat", invals, attributes=[
@@ -263,7 +371,7 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, consts, graph_name="model",
     graph_inputs = []
     for var, name in zip(jaxpr.invars[:len(input_names)], input_names):
         b.names[id(var)] = name
-        dt = proto.NP_TO_ONNX[str(var.aval.dtype)]
+        dt = _onnx_dtype(var.aval.dtype)
         graph_inputs.append(proto.value_info(name, dt, var.aval.shape))
     for var, arr in zip(jaxpr.invars[len(input_names):], consts):
         b.names[id(var)] = b.add_const(np.asarray(arr), hint="w")
@@ -275,7 +383,7 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, consts, graph_name="model",
     graph_outputs = []
     for var in jaxpr.outvars:
         nm = b.name_of(var)
-        dt = proto.NP_TO_ONNX[str(var.aval.dtype)]
+        dt = _onnx_dtype(var.aval.dtype)
         graph_outputs.append(proto.value_info(nm, dt, var.aval.shape))
 
     graph = proto.graph_proto(b.nodes, graph_name, b.initializers,

@@ -178,6 +178,15 @@ def run_model(model: OnnxModel, feeds: Dict[str, np.ndarray]) -> List:
             out = np.where(i[0].astype(bool), i[1], i[2])
         elif op == "Identity":
             out = i[0]
+        elif op == "Slice":
+            starts, ends, axes, steps = (list(map(int, v)) for v in i[1:5])
+            sl = [slice(None)] * i[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[ax] = slice(st, en, sp)
+            out = i[0][tuple(sl)]
+        elif op == "Gather":
+            out = np.take(i[0], i[1].astype(np.int64),
+                          axis=node.attrs.get("axis", 0))
         elif op == "Concat":
             out = np.concatenate(i, axis=node.attrs["axis"])
         elif op in ("MaxPool", "AveragePool"):

@@ -136,3 +136,25 @@ def test_opset13_forms_and_validation(tmp_path):
         paddle.onnx.export(MLP(), str(tmp_path / "cfg"),
                            input_spec=[InputSpec((1, 8), "float32")],
                            export_params=False)
+
+
+def test_bert_tiny_transformer_export_parity(tmp_path):
+    """A full transformer (embeddings, attention einsums as general
+    dot_general, LayerNorm, gelu, tied MLM head) exports and the decoded
+    graph matches the model numerically."""
+    from paddle_tpu.models.bert import BertForMaskedLM, bert_tiny
+
+    paddle.seed(0)
+    m = BertForMaskedLM(bert_tiny())
+    m.eval()
+    p = onnx_export.export(m, str(tmp_path / "bert"),
+                           input_spec=[InputSpec((2, 128), "int32")])
+    model = onnx_export.load_model(p)
+    ops = {n.op for n in model.nodes}
+    assert {"MatMul", "Gather", "Erf", "Transpose"} <= ops
+    ids = np.random.default_rng(0).integers(0, 256, (2, 128)) \
+        .astype(np.int32)
+    (out,) = onnx_export.run_model(model, {"x0": ids})
+    with no_grad():
+        ref = m(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
