@@ -158,3 +158,20 @@ def test_bert_tiny_transformer_export_parity(tmp_path):
     with no_grad():
         ref = m(paddle.to_tensor(ids)).numpy()
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt_tiny_causal_export_parity(tmp_path):
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+
+    paddle.seed(1)
+    m = GPTForPretraining(gpt_tiny())
+    m.eval()
+    p = onnx_export.export(m, str(tmp_path / "gpt"),
+                           input_spec=[InputSpec((2, 64), "int32")])
+    model = onnx_export.load_model(p)
+    ids = np.random.default_rng(1).integers(0, 256, (2, 64)) \
+        .astype(np.int32)
+    (out,) = onnx_export.run_model(model, {"x0": ids})
+    with no_grad():
+        ref = m(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(out, ref, atol=3e-4, rtol=3e-4)
