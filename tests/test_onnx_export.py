@@ -175,3 +175,24 @@ def test_gpt_tiny_causal_export_parity(tmp_path):
     with no_grad():
         ref = m(paddle.to_tensor(ids)).numpy()
     np.testing.assert_allclose(out, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_ernie_multi_output_export_parity(tmp_path):
+    """Multi-output graph: ERNIE's (MLM scores, SOP logits) both export
+    and execute to parity."""
+    from paddle_tpu.models.ernie import ErnieForPretraining, ernie_tiny
+
+    paddle.seed(2)
+    m = ErnieForPretraining(ernie_tiny())
+    m.eval()
+    p = onnx_export.export(m, str(tmp_path / "ernie"),
+                           input_spec=[InputSpec((2, 64), "int32")])
+    model = onnx_export.load_model(p)
+    assert len(model.outputs) == 2
+    ids = np.random.default_rng(2).integers(0, 256, (2, 64)) \
+        .astype(np.int32)
+    outs = onnx_export.run_model(model, {"x0": ids})
+    with no_grad():
+        refs = m(paddle.to_tensor(ids))
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o, r.numpy(), atol=3e-4, rtol=3e-4)
