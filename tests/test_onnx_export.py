@@ -196,3 +196,24 @@ def test_ernie_multi_output_export_parity(tmp_path):
         refs = m(paddle.to_tensor(ids))
     for o, r in zip(outs, refs):
         np.testing.assert_allclose(o, r.numpy(), atol=3e-4, rtol=3e-4)
+
+
+def test_resnet18_export_parity(tmp_path):
+    """CV family: ResNet-18 (convs, eval-mode BN, residual adds, pools)
+    exports and executes to parity."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(3)
+    m = resnet18(num_classes=10)
+    m.eval()
+    p = onnx_export.export(m, str(tmp_path / "r18"),
+                           input_spec=[InputSpec((1, 3, 64, 64),
+                                                 "float32")])
+    model = onnx_export.load_model(p)
+    assert {"Conv", "MaxPool"} <= {n.op for n in model.nodes}
+    x = np.random.default_rng(3).normal(size=(1, 3, 64, 64)) \
+        .astype(np.float32)
+    (out,) = onnx_export.run_model(model, {"x0": x})
+    with no_grad():
+        ref = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
