@@ -454,6 +454,26 @@ def pop_static_recorder():
     return _static_recorders.pop()
 
 
+def record_mutation(target, new_value):
+    """In-place state write (BN/IN running stats, quant moving averages,
+    spectral-norm power-iteration vectors): assign ``target._data`` and,
+    when a static recorder is active, record the write as an event in the
+    op stream so Executor replay carries the mutation forward (reference:
+    framework/executor.cc:170 — the reference Executor runs stat-update
+    ops like any other op; here writes are explicit replayable events).
+
+    While recording, the live tensor is NOT mutated: the build pass runs
+    on placeholder zeros (the reference's Program build does not execute at
+    all), so letting it write through would pollute real state with
+    placeholder statistics; state starts evolving at the first
+    Executor.run, which writes final buffer values back."""
+    if _static_recorders and isinstance(new_value, Tensor):
+        _static_recorders[-1]._record_write(target, new_value)
+        return
+    target._data = new_value._data if isinstance(new_value, Tensor) \
+        else new_value
+
+
 def apply(fn: Callable, *args, name: str = "", **static_kw):
     """Execute ``fn`` over raw arrays; record a VJP tape node if needed;
     when a static-graph recorder is active (static.program_guard), also

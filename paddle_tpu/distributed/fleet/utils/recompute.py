@@ -23,7 +23,7 @@ __all__ = ["recompute", "recompute_sequential"]
 
 
 def recompute(function, *args, use_reentrant: bool = True,
-              preserve_rng_state: bool = True, **kwargs):
+              preserve_rng_state: bool = True, policy=None, **kwargs):
     """Run ``function(*args)`` with activation checkpointing.
 
     ``function`` may be a Layer (its parameters join the gradient path) or
@@ -31,6 +31,12 @@ def recompute(function, *args, use_reentrant: bool = True,
     inputs + params and rematerializes intermediates (reference:
     fleet/utils/recompute.py:63; here via jax.checkpoint, which also
     applies inside a jitted TrainStep trace).
+
+    ``policy`` (TPU-native extension): a ``jax.checkpoint_policies``
+    predicate for SELECTIVE checkpointing — e.g.
+    ``dots_with_no_batch_dims_saveable`` keeps matmul outputs resident and
+    rematerializes only the cheap elementwise tail, a far better
+    FLOPs/HBM trade than full recompute on TPU.
     """
     del use_reentrant, preserve_rng_state   # parity knobs; single behavior
 
@@ -88,7 +94,7 @@ def recompute(function, *args, use_reentrant: bool = True,
         flat = tuple(o._data if isinstance(o, Tensor) else o for o in outs)
         return flat if len(flat) > 1 else flat[0]
 
-    ck = jax.checkpoint(pure)
+    ck = jax.checkpoint(pure, policy=policy)
     return apply(ck, *p_tensors, *loose_tensors, *tensor_args,
                  name="recompute")
 

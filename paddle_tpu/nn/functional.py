@@ -17,7 +17,7 @@ import numpy as np
 from ..core import dtypes
 from ..core.flags import matmul_precision
 from ..core.random import in_trace_rng, make_rng
-from ..core.tensor import Tensor, apply
+from ..core.tensor import Tensor, apply, record_mutation
 
 __all__ = [
     # activations
@@ -695,9 +695,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         if weight is not None:
             args += [_t(weight), _t(bias)]
         out, new_rm, new_rv = apply(_bn_train, *args, name="batch_norm")
-        # in-place update of running stats (buffers)
-        running_mean._data = new_rm.data
-        running_var._data = new_rv.data
+        # in-place update of running stats (buffers); recorded as replayable
+        # write events when a static Program is being built
+        record_mutation(running_mean, new_rm)
+        record_mutation(running_var, new_rv)
         return out
 
     def _bn_eval(a, rm, rv, *wb):

@@ -83,6 +83,8 @@ class SyncBatchNorm(_BatchNormBase):
         from ...core.tensor import apply
         import jax
 
+        mom = self._momentum
+
         def _sync_bn(a, rm, rv, w, b):
             red = tuple(i for i in range(a.ndim) if i != 1)
             local_mean = jnp.mean(a.astype(jnp.float32), axis=red)
@@ -94,12 +96,19 @@ class SyncBatchNorm(_BatchNormBase):
             shape[1] = a.shape[1]
             out = (a - mean.reshape(shape).astype(a.dtype)) * \
                 jax.lax.rsqrt(var.reshape(shape) + self._epsilon).astype(a.dtype)
-            return out * w.reshape(shape) + b.reshape(shape)
+            new_rm = mom * rm + (1 - mom) * jax.lax.stop_gradient(mean)
+            new_rv = mom * rv + (1 - mom) * jax.lax.stop_gradient(var)
+            return out * w.reshape(shape) + b.reshape(shape), new_rm, new_rv
 
         if not self.training:
             return super().forward(x)
-        return apply(_sync_bn, x, self._mean, self._variance, self.weight,
-                     self.bias, name="sync_batch_norm")
+        out, new_rm, new_rv = apply(
+            _sync_bn, x, self._mean, self._variance, self.weight,
+            self.bias, name="sync_batch_norm")
+        from ...core.tensor import record_mutation
+        record_mutation(self._mean, new_rm)
+        record_mutation(self._variance, new_rv)
+        return out
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

@@ -94,15 +94,17 @@ class FakeQuantMovingAverageAbsMax(Layer):
         rate = self.moving_rate
 
         def _update(a, sc, st):
-            absmax = jnp.max(jnp.abs(a))
+            # range tracking is state, not a gradient path
+            absmax = jnp.max(jnp.abs(jax.lax.stop_gradient(a)))
             st2 = st * rate + 1.0
             sc2 = (sc * rate * st + absmax) / st2
-            return sc2, st2
+            return jax.lax.stop_gradient(sc2), jax.lax.stop_gradient(st2)
 
         sc2, st2 = apply(_update, x, self.scale, self.state,
                          name="moving_average_abs_max_update")
-        self.scale._data = jax.lax.stop_gradient(sc2._data)
-        self.state._data = jax.lax.stop_gradient(st2._data)
+        from ...core.tensor import record_mutation
+        record_mutation(self.scale, sc2)
+        record_mutation(self.state, st2)
 
     def forward(self, x):
         qmax = 2.0 ** (self.quant_bits - 1) - 1

@@ -127,10 +127,12 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
                 uv = mat @ vv
                 uv = uv / (jnp.linalg.norm(uv) + eps)
             sigma = uv @ mat @ vv
-            return ((a.astype(jnp.float32) / sigma).astype(a.dtype), uv)
+            return ((a.astype(jnp.float32) / sigma).astype(a.dtype),
+                    _jax.lax.stop_gradient(uv))
 
         eff, u_new = apply(_sn, wv, u, name="spectral_norm_apply")
-        u._data = _jax.lax.stop_gradient(u_new._data)
+        from ...core.tensor import record_mutation
+        record_mutation(u, u_new)
         object.__setattr__(lyr, name, eff)
         return None
 

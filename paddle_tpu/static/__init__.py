@@ -57,6 +57,7 @@ class Program:
         self._optimizer = None
         self._loss = None
         self._run_cache: Dict = {}
+        self._mutated: List[int] = []   # buffer ids written during build
 
     # -- recording (called by core.tensor.apply) ------------------------
     def _record_op(self, fn, name, static_kw, args, result):
@@ -81,6 +82,19 @@ class Program:
                 out_ids.append(None)
         self._ops.append((fn, name, static_kw, in_spec, out_ids))
 
+    def _record_write(self, target, src):
+        """Record an in-place state write (core.tensor.record_mutation):
+        from here on, reads of ``target`` resolve to ``src``'s value, and
+        Executor.run writes the final value back to the live Tensor — BN
+        running stats train under Executor.run exactly as the reference's
+        (executor.cc:170 runs the stat-update ops of the ProgramDesc)."""
+        self._tensors[id(target)] = target
+        self._tensors[id(src)] = src
+        self._ops.append((None, "__write__", None,
+                          [("t", id(src))], [id(target)]))
+        if id(target) not in self._mutated:
+            self._mutated.append(id(target))
+
     def add_placeholder(self, name, tensor):
         self._placeholders[name] = tensor
         self._tensors[id(tensor)] = tensor
@@ -92,6 +106,11 @@ class Program:
             vals = [(env[v] if v in env else self._tensors[v]._data)
                     if kind == "t" else v
                     for kind, v in in_spec]
+            if name == "__write__":       # buffer write: alias, no compute
+                # state is never a gradient path: cut here so a read-after-
+                # write (QAT scales) can't backprop through the update
+                env[out_ids[0]] = jax.lax.stop_gradient(vals[0])
+                continue
             out = fn(*vals, **static_kw) if static_kw else fn(*vals)
             outs = out if isinstance(out, (tuple, list)) else [out]
             for oid, o in zip(out_ids, outs):
@@ -102,15 +121,20 @@ class Program:
     def leaf_ids(self):
         """Tensor inputs that are neither op outputs nor placeholders:
         parameters, buffers, captured constants. Passed FRESH into every
-        replay so state reads are never baked as trace constants."""
-        produced = {oid for *_, out_ids in self._ops for oid in out_ids
-                    if oid is not None}
+        replay so state reads are never baked as trace constants.
+
+        Order-aware: an id read BEFORE any op (or write event) produces it
+        is a leaf even if later overwritten — a BN running-stat buffer is
+        both a leaf (its pre-step value feeds the normalization) and a
+        write target (its post-step value is fetched back)."""
+        produced = set()
         ph = {id(t) for t in self._placeholders.values()}
         leaves = []
         for fn, name, static_kw, in_spec, out_ids in self._ops:
             for kind, v in in_spec:
                 if kind == "t" and v not in produced and v not in ph:
                     leaves.append(v)
+            produced.update(o for o in out_ids if o is not None)
         return sorted(set(leaves))
 
     def global_block(self):
@@ -276,11 +300,13 @@ class Executor:
         # ALL leaves (params, buffers, captured tensors) enter the jitted
         # replay as arguments, re-read each run — never baked as
         # trace-time constants (running stats would otherwise freeze).
-        # NOTE: buffer WRITES are not replayed; mutation-during-training
-        # state (BatchNorm running stats) updates only on the eager build
-        # pass — train BN models eagerly or with use_global_stats.
+        # Buffer WRITES recorded via core.tensor.record_mutation replay as
+        # alias events; their final values are fetched with the outputs and
+        # written back to the live Tensors below, so BN/IN running stats
+        # train under Executor.run (reference: executor.cc:170).
         leaf_arrs = {lid: program._tensors[lid]._data
                      for lid in program.leaf_ids()}
+        mutated = [mid for mid in program._mutated]
         param_arrs = {pid: leaf_arrs.pop(pid)
                       for pid in list(params)
                       if pid in leaf_arrs}
@@ -296,7 +322,8 @@ class Executor:
                 env.update(leaf_d)
                 env.update(param_d)
                 env = program._replay(env)
-                return [env[fid] for fid in fetch_ids]
+                return ([env[fid] for fid in fetch_ids],
+                        {mid: env[mid] for mid in mutated})
 
             fwd_jit = _jax.jit(forward)
             grad_jit = None
@@ -309,16 +336,26 @@ class Executor:
                     env.update(param_d)
                     env = program._replay(env)
                     fetched = [env[fid] for fid in fetch_ids]
-                    return env[loss_id].astype(jax.numpy.float32), fetched
+                    muts = {mid: env[mid] for mid in mutated}
+                    return (env[loss_id].astype(jax.numpy.float32),
+                            (fetched, muts))
 
+                # stat-update paths must not leak into the parameter
+                # gradients — the EMA write is stop-gradient by nature
                 grad_jit = _jax.jit(_jax.value_and_grad(loss_fn,
                                                         has_aux=True))
             fns = (fwd_jit, grad_jit)
             program._run_cache[sig] = fns
         fwd_jit, grad_jit = fns
 
+        def write_back(muts):
+            for mid, val in muts.items():
+                program._tensors[mid]._data = val
+
         if train:
-            (_, fetched), grads = grad_jit(param_arrs, feed_arrs, leaf_arrs)
+            (_, (fetched, muts)), grads = grad_jit(param_arrs, feed_arrs,
+                                                   leaf_arrs)
+            write_back(muts)
             # hand gradients to the optimizer's own fused update
             for pid, t in params.items():
                 g = grads.get(pid)
@@ -333,7 +370,9 @@ class Executor:
             opt.step()
             program._optimizer.clear_grad()
             return fetched
-        return fwd_jit(feed_arrs, param_arrs, leaf_arrs)
+        fetched, muts = fwd_jit(feed_arrs, param_arrs, leaf_arrs)
+        write_back(muts)
+        return fetched
 
 
 # static-style layer helpers + functional control flow live in static.nn

@@ -109,6 +109,103 @@ def test_missing_feed_raises_and_leaves_stay_fresh():
     np.testing.assert_allclose(o2, 3.0)
 
 
+def test_bn_buffer_writes_replay_under_executor():
+    """A BN conv net trained via Executor.run must update running stats
+    exactly as its eager twin (VERDICT r3 #2; reference executor.cc:170
+    runs the stat-update ops of the program like any other op)."""
+    from paddle_tpu.nn import functional as F
+
+    def make():
+        paddle.seed(7)
+        return nn.Sequential(
+            nn.Conv2D(1, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(),
+            nn.Flatten(), nn.Linear(4 * 8 * 8, 2))
+
+    net_s = make()
+    net_e = make()
+    net_s.train()
+    net_e.train()
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 1, 8, 8], "float32")
+        yt = static.data("y", [None], "int64")
+        loss = F.cross_entropy(net_s(x), yt)
+        paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    # the build pass ran on placeholder zeros: recorded state must be
+    # untouched (reference Program building does not execute)
+    bn_s = net_s[1]
+    np.testing.assert_allclose(bn_s._mean.numpy(), 0.0)
+    np.testing.assert_allclose(bn_s._variance.numpy(), 1.0)
+
+    exe = static.Executor()
+    opt_e = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net_e.parameters())
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        xb = rng.normal(size=(16, 1, 8, 8)).astype(np.float32)
+        yb = rng.integers(0, 2, (16,)).astype(np.int64)
+        (ls,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        le = F.cross_entropy(net_e(paddle.to_tensor(xb)),
+                             paddle.to_tensor(yb))
+        le.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        np.testing.assert_allclose(float(ls), float(le), rtol=1e-4,
+                                   atol=1e-5)
+
+    bn_e = net_e[1]
+    # stats moved off their init AND match the eager twin step for step
+    assert not np.allclose(bn_s._mean.numpy(), 0.0)
+    assert not np.allclose(bn_s._variance.numpy(), 1.0)
+    np.testing.assert_allclose(bn_s._mean.numpy(), bn_e._mean.numpy(),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(bn_s._variance.numpy(),
+                               bn_e._variance.numpy(), rtol=1e-4, atol=1e-6)
+
+    # eval-mode replay of the SAME weights agrees once stats are synced
+    net_s.eval()
+    infer = static.Program()
+    with static.program_guard(infer):
+        xi = static.data("x", [None, 1, 8, 8], "float32")
+        logits = net_s(xi)
+    xb = rng.normal(size=(4, 1, 8, 8)).astype(np.float32)
+    (out_s,) = exe.run(infer, feed={"x": xb}, fetch_list=[logits])
+    net_e.eval()
+    out_e = net_e(paddle.to_tensor(xb)).numpy()
+    np.testing.assert_allclose(out_s, out_e, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_convergence_under_executor():
+    """Book-style convergence: BN net under Executor.run learns a separable
+    task and its eval accuracy uses the trained running stats."""
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(2, 16), nn.BatchNorm1D(16), nn.ReLU(),
+                        nn.Linear(16, 2))
+    net.train()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        yt = static.data("y", [None], "int64")
+        loss = F.cross_entropy(net(x), yt)
+        paddle.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    rng = np.random.default_rng(2)
+    losses = []
+    for _ in range(60):
+        xb = rng.normal(size=(64, 2)).astype(np.float32) + 0.5
+        yb = (xb[:, 0] + xb[:, 1] > 1.0).astype(np.int64)
+        (lv,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.25 < losses[0], (losses[0], losses[-1])
+    # running stats converged near the true feed distribution (mean ~0.5)
+    bn = net[1]
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+
+
 def test_empty_program_fetch_errors():
     import pytest
 
