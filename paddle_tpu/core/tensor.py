@@ -454,6 +454,18 @@ def pop_static_recorder():
     return _static_recorders.pop()
 
 
+def annotate_test_variant(test_fn):
+    """Attach a test-mode twin to the op just recorded (call immediately
+    after the ``apply`` that recorded it): ``Program.clone(for_test=True)``
+    swaps the recorded train-mode fn for this one — the analogue of the
+    reference's is_test attribute flip in clone-for-test
+    (framework.py Program.clone). The twin takes the SAME inputs and may
+    return fewer outputs (trailing train-only outputs feed only write
+    events, which clone-for-test strips)."""
+    if _static_recorders:
+        _static_recorders[-1]._annotate_test_variant(test_fn)
+
+
 def record_mutation(target, new_value):
     """In-place state write (BN/IN running stats, quant moving averages,
     spectral-norm power-iteration vectors): assign ``target._data`` and,

@@ -17,7 +17,8 @@ import numpy as np
 from ..core import dtypes
 from ..core.flags import matmul_precision
 from ..core.random import in_trace_rng, make_rng
-from ..core.tensor import Tensor, apply, record_mutation
+from ..core.tensor import (Tensor, annotate_test_variant, apply,
+                           record_mutation)
 
 __all__ = [
     # activations
@@ -676,6 +677,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     use_batch_stats = training and not use_global_stats
 
+    def _bn_eval(a, rm, rv, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - rm.reshape(shape).astype(a.dtype)) * \
+            jax.lax.rsqrt(rv.reshape(shape).astype(jnp.float32) + epsilon).astype(a.dtype)
+        if wb:
+            w, b = wb
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+
     if use_batch_stats:
         def _bn_train(a, rm, rv, *wb):
             mean = jnp.mean(a.astype(jnp.float32), axis=reduce_axes)
@@ -696,19 +707,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             args += [_t(weight), _t(bias)]
         out, new_rm, new_rv = apply(_bn_train, *args, name="batch_norm")
         # in-place update of running stats (buffers); recorded as replayable
-        # write events when a static Program is being built
+        # write events when a static Program is being built, with the eval
+        # normalization as the clone(for_test=True) twin
+        annotate_test_variant(_bn_eval)
         record_mutation(running_mean, new_rm)
         record_mutation(running_var, new_rv)
-        return out
-
-    def _bn_eval(a, rm, rv, *wb):
-        shape = [1] * a.ndim
-        shape[ch_axis] = a.shape[ch_axis]
-        out = (a - rm.reshape(shape).astype(a.dtype)) * \
-            jax.lax.rsqrt(rv.reshape(shape).astype(jnp.float32) + epsilon).astype(a.dtype)
-        if wb:
-            w, b = wb
-            out = out * w.reshape(shape) + b.reshape(shape)
         return out
 
     args = [x, _t(running_mean), _t(running_var)]
@@ -824,7 +827,12 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return _t(x)
     if p >= 1.0:
         x = _t(x)
-        return apply(lambda a: jnp.zeros_like(a), x, name="dropout")
+        out = apply(lambda a: jnp.zeros_like(a), x, name="dropout")
+        if mode == "upscale_in_train":
+            annotate_test_variant(lambda a: a)
+        else:           # downscale_in_infer at eval: x*(1-p) == 0 for p>=1
+            annotate_test_variant(lambda a: jnp.zeros_like(a))
+        return out
     key = make_rng(rng_name)
 
     def _do(a):
@@ -850,7 +858,13 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return apply(_do, _t(x), name="dropout")
+    out = apply(_do, _t(x), name="dropout")
+    # clone(for_test=True) twin: identity (upscale_in_train) / (1-p) scale
+    if mode == "upscale_in_train":
+        annotate_test_variant(lambda a: a)
+    else:
+        annotate_test_variant(lambda a: (a * (1.0 - p)).astype(a.dtype))
+    return out
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -878,7 +892,9 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         b_coef = -a_coef * alpha_p * p
         return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
 
-    return apply(_ad, _t(x), name="alpha_dropout")
+    out = apply(_ad, _t(x), name="alpha_dropout")
+    annotate_test_variant(lambda a: a)   # eval: identity
+    return out
 
 
 # ---------------------------------------------------------------------------

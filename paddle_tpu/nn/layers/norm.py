@@ -102,10 +102,20 @@ class SyncBatchNorm(_BatchNormBase):
 
         if not self.training:
             return super().forward(x)
+        eps = self._epsilon
+
+        def _sync_bn_eval(a, rm, rv, w, b):
+            shape = [1] * a.ndim
+            shape[1] = a.shape[1]
+            out = (a - rm.reshape(shape).astype(a.dtype)) * \
+                jax.lax.rsqrt(rv.reshape(shape) + eps).astype(a.dtype)
+            return out * w.reshape(shape) + b.reshape(shape)
+
         out, new_rm, new_rv = apply(
             _sync_bn, x, self._mean, self._variance, self.weight,
             self.bias, name="sync_batch_norm")
-        from ...core.tensor import record_mutation
+        from ...core.tensor import annotate_test_variant, record_mutation
+        annotate_test_variant(_sync_bn_eval)
         record_mutation(self._mean, new_rm)
         record_mutation(self._variance, new_rv)
         return out

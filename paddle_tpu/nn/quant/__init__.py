@@ -102,7 +102,8 @@ class FakeQuantMovingAverageAbsMax(Layer):
 
         sc2, st2 = apply(_update, x, self.scale, self.state,
                          name="moving_average_abs_max_update")
-        from ...core.tensor import record_mutation
+        from ...core.tensor import annotate_test_variant, record_mutation
+        annotate_test_variant(lambda a, sc, st: (sc, st))  # frozen at eval
         record_mutation(self.scale, sc2)
         record_mutation(self.state, st2)
 

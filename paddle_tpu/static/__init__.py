@@ -58,6 +58,7 @@ class Program:
         self._loss = None
         self._run_cache: Dict = {}
         self._mutated: List[int] = []   # buffer ids written during build
+        self._test_variants: Dict[int, object] = {}  # op idx -> eval twin
 
     # -- recording (called by core.tensor.apply) ------------------------
     def _record_op(self, fn, name, static_kw, args, result):
@@ -81,6 +82,12 @@ class Program:
             else:
                 out_ids.append(None)
         self._ops.append((fn, name, static_kw, in_spec, out_ids))
+
+    def _annotate_test_variant(self, test_fn):
+        """Register an eval-mode twin for the most recently recorded op
+        (core.tensor.annotate_test_variant)."""
+        if self._ops:
+            self._test_variants[len(self._ops) - 1] = test_fn
 
     def _record_write(self, target, src):
         """Record an in-place state write (core.tensor.record_mutation):
@@ -141,8 +148,32 @@ class Program:
         return self
 
     def clone(self, for_test=False):
+        """Copy this program. ``for_test=True`` converts it to inference
+        form (reference: framework.py Program.clone(for_test=True), which
+        flips ops' is_test attributes): train-only ops (BN batch-stat
+        normalization, dropout, QAT range tracking) are swapped for their
+        recorded eval twins, state-write events are stripped, and the
+        optimizer/loss attachment is dropped."""
         import copy
-        return copy.copy(self)
+        out = copy.copy(self)
+        out._run_cache = {}
+        if not for_test:
+            return out
+        out._ops = []
+        out._test_variants = {}
+        for i, (fn, name, static_kw, in_spec, out_ids) in \
+                enumerate(self._ops):
+            if name == "__write__":
+                continue                       # no state mutation at eval
+            twin = self._test_variants.get(i)
+            if twin is not None:
+                fn = twin
+                name = name + "__test"
+            out._ops.append((fn, name, static_kw, in_spec, out_ids))
+        out._mutated = []
+        out._optimizer = None
+        out._loss = None
+        return out
 
 
 _default_main = [Program()]

@@ -177,6 +177,47 @@ def test_bn_buffer_writes_replay_under_executor():
     np.testing.assert_allclose(out_s, out_e, rtol=1e-4, atol=1e-5)
 
 
+def test_clone_for_test_swaps_train_ops():
+    """clone(for_test=True) must strip stat writes AND swap BN/dropout to
+    eval behavior (reference: Program.clone flips is_test), so repeated
+    inference neither corrupts running stats nor applies dropout."""
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(3, 8), nn.BatchNorm1D(8), nn.ReLU(),
+                        nn.Dropout(0.5), nn.Linear(8, 2))
+    net.train()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        yt = static.data("y", [None], "int64")
+        logits = net(x)
+        loss = F.cross_entropy(logits, yt)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    rng = np.random.default_rng(4)
+    xb = rng.normal(size=(8, 3)).astype(np.float32)
+    yb = rng.integers(0, 2, (8,)).astype(np.int64)
+    exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    bn = net[1]
+    mean_after_train = bn._mean.numpy().copy()
+
+    # eval runs: deterministic (no dropout), stats untouched, and BN
+    # normalizes with RUNNING stats (eager eval twin agrees)
+    (o1,) = exe.run(test_prog, feed={"x": xb, "y": yb},
+                    fetch_list=[logits])
+    (o2,) = exe.run(test_prog, feed={"x": xb, "y": yb},
+                    fetch_list=[logits])
+    np.testing.assert_allclose(o1, o2)
+    np.testing.assert_allclose(bn._mean.numpy(), mean_after_train)
+    net.eval()
+    np.testing.assert_allclose(o1, net(paddle.to_tensor(xb)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    net.train()
+
+
 def test_bn_convergence_under_executor():
     """Book-style convergence: BN net under Executor.run learns a separable
     task and its eval accuracy uses the trained running stats."""

@@ -1,7 +1,6 @@
 """Dev tool: attribute GPT-2 345M step time by timing ablations on the chip.
 
-Usage: python tools/prof_gpt.py [mode ...]
-Modes: base fwdonly gradsonly nodrop b16_selremat b16_fullremat b12 b16_seldot
+Usage: python tools/prof_gpt.py [mode ...|all]   (modes: see MODES dict)
 """
 import os
 import sys
@@ -49,15 +48,20 @@ def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
     cfg = gpt2_medium(use_recompute=(remat is not None),
                       hidden_dropout_prob=drop, attention_dropout_prob=drop)
     paddle.seed(0)
+    import paddle_tpu.distributed.fleet.utils.recompute  # noqa: F401
+    # the package attr `recompute` is the *function* (star-import shadows
+    # the submodule) — bind the module via sys.modules
+    rc = sys.modules["paddle_tpu.distributed.fleet.utils.recompute"]
+    utils_pkg = sys.modules["paddle_tpu.distributed.fleet.utils"]
     if remat == "dots":
-        import paddle_tpu.distributed.fleet.utils.recompute as rc
-
         def sel(fn, *a, **k):
             return rc.recompute(
                 fn, *a,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 **k)
-        sys.modules["paddle_tpu.distributed.fleet.utils"].recompute = sel
+        utils_pkg.recompute = sel
+    else:  # undo a selective-remat patch left by an earlier mode
+        utils_pkg.recompute = rc.recompute
     model = GPTForPretraining(cfg)
     model.train()
     crit = GPTPretrainingCriterion()
