@@ -57,6 +57,9 @@ _DEFAULTS: Dict[str, Any] = {
     "fuse_grad_size_in_MB": 32,        # parity no-op
     "nccl_comm_num": 1,                # parity no-op
     "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd": False,
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
     "dgc": False,
     "lamb": False,
     "lars": False,
@@ -81,14 +84,12 @@ class DistributedStrategy:
         cfg = self.__dict__["_config"]
         if name not in cfg:
             raise AttributeError(f"DistributedStrategy has no field {name!r}")
-        if name == "localsgd" and value:
-            raise NotImplementedError(
-                "localsgd is not implemented: LocalSGD trades gradient "
-                "allreduce frequency for staleness on slow interconnects; "
-                "on TPU the dp allreduce rides ICI inside the compiled "
-                "step, so the TPU-native answer is plain data parallelism "
-                "(optionally with strategy.gradient_merge for larger "
-                "effective batches)")
+        # localsgd is implemented (reference:
+        # fleet/meta_optimizers/localsgd_optimizer.py): build the train
+        # step with distributed.fleet.meta_optimizers.LocalSGDTrainStep,
+        # which runs k local steps per replica (shard_map, zero ICI
+        # traffic) then one parameter pmean; adaptive=True gives the
+        # AdaComm schedule.
         if name == "dgc" and value:
             raise NotImplementedError(
                 "dgc (deep gradient compression) is not implemented: it "

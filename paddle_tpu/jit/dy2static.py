@@ -1,25 +1,39 @@
-"""Dygraph-to-static AST transform: python `if`/`while` over tensors.
+"""Dygraph-to-static AST transform: python control flow over tensors.
 
 reference parity: the dygraph_to_static AST translator
 (reference: python/paddle/fluid/dygraph/dygraph_to_static/
-program_translator.py:768, ifelse_transformer.py IfElseTransformer,
-loop_transformer.py LoopTransformer) which rewrites python control flow
-into conditional_block/while ops.
+program_translator.py:768, ifelse_transformer.py, loop_transformer.py,
+break_continue_transformer.py, return_transformer.py) which rewrites
+python control flow into conditional_block/while ops.
 
-TPU-native redesign: the transform functionalizes each `if`/`while`
-into a call to a dispatch helper — `__jst_if__` / `__jst_while__` —
-passing the variables either branch assigns as explicit arguments
-(parameters shadow the outer names, so branch bodies run unchanged).
-At RUNTIME the helper checks the condition's type: a concrete python
-bool takes the normal python path (zero overhead, exact semantics);
-a traced/eager Tensor routes to `static.nn.cond` / `while_loop`
-(lax.cond / lax.while_loop), which is the XLA-compilable form.
+TPU-native redesign — four passes over the AST:
 
-Deliberately restricted (falls back to the untransformed statement,
-where tracing's guided ConcretizationTypeError explains the options):
-- branches containing return / break / continue / yield
-- variables created in only one branch and never defined before the if
-  (both branches must produce every output)
+1. `_ForToWhile`: ``for i in range(...)`` becomes a counter while loop
+   whose endpoints may be traced tensors (the reference's
+   loop_transformer for-to-while); other iterables keep the python
+   ``for`` (static-length tensor iteration unrolls fine under jit).
+2. `_ReturnTransformer`: ``return`` inside control flow becomes a
+   carried flag + value, with the statements after the returning block
+   guarded and loop conditions extended (return_transformer.py).
+3. `_BreakContinue`: ``break``/``continue`` become carried flags with
+   guard-`if` chains and an extended loop condition
+   (break_continue_transformer.py).
+4. `_ControlFlowTransformer`: each ``if``/``while`` is functionalized
+   into a call to a dispatch helper — `__jst_if__` / `__jst_while__` —
+   passing the assigned variables as explicit arguments. At RUNTIME the
+   helper checks the condition's type: a concrete python bool takes the
+   normal python path (zero overhead, exact semantics); a traced Tensor
+   routes to `static.nn.cond` / `while_loop` (lax.cond /
+   lax.while_loop), the XLA-compilable form. `__jst_while__` re-checks
+   per iteration, so a loop whose condition BECOMES traced mid-flight
+   (a break flag set inside a lax.cond) hands off to lax.while_loop at
+   that point.
+
+Deliberately restricted (falls back to the untransformed statement or
+the whole original function, where tracing's guided
+ConcretizationTypeError explains the options): yield anywhere;
+return inside try/finally or inside a non-range python for; scope
+declarations (global/nonlocal) or import/def/class inside a branch.
 """
 
 from __future__ import annotations
@@ -28,7 +42,7 @@ import ast
 import functools
 import inspect
 import textwrap
-from typing import Callable, Optional
+from typing import Callable, List, Optional, Tuple
 
 __all__ = ["ast_transform", "convert_to_static"]
 
@@ -52,6 +66,9 @@ class _Unbound:
 # single sentinel instance shared by all transformed functions
 _UNDEF = _Unbound()
 
+_FN_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+              ast.ClassDef)
+
 
 def _assigned_names(nodes) -> set:
     out = set()
@@ -73,28 +90,60 @@ def _has_scope_decl(nodes) -> bool:
 def _has_nonname_binding(nodes) -> bool:
     """import / def / class statements bind names invisibly to the
     Name-store scan; functionalizing such a branch would trap the binding
-    in the generated function's locals."""
-    return any(isinstance(sub, (ast.Import, ast.ImportFrom,
-                                ast.FunctionDef, ast.AsyncFunctionDef,
-                                ast.ClassDef))
-               for n in nodes for sub in ast.walk(n))
-
-
-def _has_flow_escape(nodes) -> bool:
+    in the generated function's locals. Generated `__jst_*` dispatch fns
+    are exempt — they are self-contained and re-defined per execution."""
     for n in nodes:
         for sub in ast.walk(n):
-            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
-                return True
-            if isinstance(sub, (ast.Break, ast.Continue)):
-                # only count break/continue that would escape THIS block
-                # (ones inside a nested loop are fine) — conservative:
-                # treat any as escaping
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name.startswith("__jst_"):
+                continue
+            if isinstance(sub, (ast.Import, ast.ImportFrom,
+                                ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
                 return True
     return False
 
 
+def _contains(nodes, types, stop=()) -> bool:
+    """Any node of `types` in `nodes`, not descending into nested fn
+    scopes or `stop` node types (e.g. nested loops for break/continue).
+    The top-level `nodes` themselves are always entered."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, types):
+            return True
+        if isinstance(n, _FN_SCOPES) or isinstance(n, stop):
+            continue                      # don't descend
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _has_flow_escape(nodes) -> bool:
+    """return/yield/break/continue that would escape this block (after
+    passes 1-3 these only remain in untransformable shapes)."""
+    return _contains(nodes, (ast.Return, ast.Yield, ast.YieldFrom,
+                             ast.Break, ast.Continue))
+
+
 def _load(name):
     return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_store(name)], value=value)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _call(fname, *args):
+    return ast.Call(func=_load(fname), args=list(args), keywords=[])
 
 
 def _fn_def(name, args, body):
@@ -111,13 +160,217 @@ def _undef_guard(name):
         body=[ast.Expr(value=_load(name))],
         handlers=[ast.ExceptHandler(
             type=_load("NameError"), name=None,
-            body=[ast.Assign(targets=[_store(name)],
-                             value=_load("__jst_undef__"))])],
+            body=[_assign(name, _load("__jst_undef__"))])],
         orelse=[], finalbody=[])
 
 
-def _store(name):
-    return ast.Name(id=name, ctx=ast.Store())
+def _guard_if(flag_expr, body):
+    """`if __jst_not__(<flag_expr>): <body>` — the statements following a
+    flag-setting block, suppressed once the flag fires."""
+    return ast.If(test=_call("__jst_not__", flag_expr), body=body,
+                  orelse=[])
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: for-over-range -> while (loop_transformer.py for->while)
+# ---------------------------------------------------------------------------
+
+
+class _ForToWhile(ast.NodeTransformer):
+    """``for <name> in range(a[, b[, c]]):`` becomes a counter while loop
+    so tensor-valued endpoints compile to lax.while_loop. Non-range
+    iterables keep the python for: a static-length tensor unrolls under
+    jit; python sequences have exact python semantics."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and 1 <= len(it.args) <= 3
+                and not it.keywords
+                and not any(isinstance(a, ast.Starred) for a in it.args)):
+            return node
+        self._counter += 1
+        k = self._counter
+        i, stop, step = (f"_jst_i_{k}", f"_jst_stop_{k}", f"_jst_step_{k}")
+        tgt = node.target.id
+        prologue = [
+            ast.Assign(
+                targets=[ast.Tuple(elts=[_store(i), _store(stop),
+                                         _store(step)], ctx=ast.Store())],
+                value=_call("__jst_range3__", *it.args)),
+            # bind the loop target before the while so it can be a
+            # lax.while_loop carry (divergence from python: after a
+            # ZERO-iteration loop the target holds the start value
+            # instead of being unbound — the reference's loop transform
+            # makes the same trade)
+            _assign(tgt, _load(i)),
+        ]
+        body = ([_assign(tgt, _load(i))] + list(node.body)
+                + [_assign(i, ast.BinOp(left=_load(i), op=ast.Add(),
+                                        right=_load(step)))])
+        loop = ast.While(
+            test=_call("__jst_range_cont__", _load(i), _load(stop),
+                       _load(step)),
+            body=body, orelse=[])
+        return prologue + [loop]
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: return inside control flow -> flag + value
+# (return_transformer.py)
+# ---------------------------------------------------------------------------
+
+_RET_FLAG = "_jst_ret_flag"
+_RET_VAL = "_jst_ret_val"
+
+
+class _Fallback(Exception):
+    """Shape the transform cannot express; degrade to the original fn."""
+
+
+def _transform_returns(fn_def) -> bool:
+    """Rewrite returns nested inside If/While into `_jst_ret_flag/_val`
+    assignments with guard chains; returns True if anything changed.
+    Raises _Fallback for shapes we refuse (yield, return in try or in a
+    python for)."""
+
+    def ret_inside_cf(stmts) -> bool:
+        for st in stmts:
+            for sub in ast.walk(st):
+                if isinstance(sub, _FN_SCOPES):
+                    continue
+                if isinstance(sub, (ast.If, ast.While, ast.For, ast.Try)):
+                    if _contains(sub.body + getattr(sub, "orelse", [])
+                                 + getattr(sub, "finalbody", []),
+                                 (ast.Return,)):
+                        return True
+        return False
+
+    if not ret_inside_cf(fn_def.body):
+        return False
+    # refuse shapes with no sound rewrite
+    for st in fn_def.body:
+        for sub in ast.walk(st):
+            if isinstance(sub, _FN_SCOPES):
+                continue
+            if isinstance(sub, (ast.Try,)) and \
+                    _contains([sub], (ast.Return,)):
+                raise _Fallback("return inside try")
+            if isinstance(sub, ast.For) and \
+                    _contains(sub.body, (ast.Return,)):
+                raise _Fallback("return inside python for")
+
+    def rew(stmts) -> Tuple[List, bool]:
+        out: List = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                out.append(_assign(_RET_VAL,
+                                   st.value or _const(None)))
+                out.append(_assign(_RET_FLAG, _const(True)))
+                return out, True           # rest is unreachable
+            if isinstance(st, (ast.If, ast.While)) and _contains(
+                    [st], (ast.Return,)):
+                if isinstance(st, ast.If):
+                    nb, _ = rew(st.body)
+                    ne, _ = rew(st.orelse)
+                    st2 = ast.If(test=st.test, body=nb or [ast.Pass()],
+                                 orelse=ne)
+                else:
+                    nb, _ = rew(st.body)
+                    st2 = ast.While(
+                        test=_call("__jst_and__",
+                                   _call("__jst_not__", _load(_RET_FLAG)),
+                                   st.test),
+                        body=nb, orelse=st.orelse)
+                out.append(st2)
+                rest, _ = rew(stmts[idx + 1:])
+                if rest:
+                    out.append(_guard_if(_load(_RET_FLAG), rest))
+                return out, True
+            out.append(st)
+        return out, False
+
+    new_body, _ = rew(fn_def.body)
+    fn_def.body = ([_assign(_RET_FLAG, _const(False)),
+                    _assign(_RET_VAL, _const(None))]
+                   + new_body
+                   + [ast.Return(value=_load(_RET_VAL))])
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: break/continue -> carried flags (break_continue_transformer.py)
+# ---------------------------------------------------------------------------
+
+
+class _BreakContinue(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)          # inner loops first
+        if node.orelse:
+            return node
+        stop_at = (ast.While, ast.For)
+        has_brk = _contains(node.body, (ast.Break,), stop=stop_at)
+        has_cnt = _contains(node.body, (ast.Continue,), stop=stop_at)
+        if not (has_brk or has_cnt):
+            return node
+        self._counter += 1
+        k = self._counter
+        brk = f"_jst_brk_{k}"
+        cnt = f"_jst_cnt_{k}"
+
+        def flags_or():
+            e = None
+            for nm in ([brk] if has_brk else []) + ([cnt] if has_cnt
+                                                   else []):
+                e = _load(nm) if e is None else _call("__jst_or__", e,
+                                                      _load(nm))
+            return e
+
+        def rew(stmts) -> Tuple[List, bool]:
+            out: List = []
+            for idx, st in enumerate(stmts):
+                if isinstance(st, ast.Break):
+                    out.append(_assign(brk, _const(True)))
+                    return out, True
+                if isinstance(st, ast.Continue):
+                    out.append(_assign(cnt, _const(True)))
+                    return out, True
+                if isinstance(st, ast.If) and _contains(
+                        [st], (ast.Break, ast.Continue), stop=stop_at):
+                    nb, _ = rew(st.body)
+                    ne, _ = rew(st.orelse)
+                    out.append(ast.If(test=st.test,
+                                      body=nb or [ast.Pass()], orelse=ne))
+                    rest, _ = rew(stmts[idx + 1:])
+                    if rest:
+                        out.append(_guard_if(flags_or(), rest))
+                    return out, True
+                out.append(st)
+            return out, False
+
+        body, _ = rew(node.body)
+        if has_cnt:
+            body = [_assign(cnt, _const(False))] + body
+        test = node.test
+        if has_brk:
+            test = _call("__jst_and__", _call("__jst_not__", _load(brk)),
+                         test)
+        prologue = [_assign(brk, _const(False))] if has_brk else []
+        return prologue + [ast.While(test=test, body=body, orelse=[])]
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: functionalize if/while (ifelse_transformer / loop_transformer)
+# ---------------------------------------------------------------------------
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -137,17 +390,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       if not m.startswith("__jst_")}
         else_names = {m for m in _assigned_names(node.orelse)
                       if not m.startswith("__jst_")}
-        if body_names != else_names:
-            # a name produced by only one branch cannot be functionalized
-            # (lax.cond branches must return identical structures); leave
-            # the python `if` intact — eager semantics are exact, and
-            # tracing raises the guided concretization error
-            return node
         if _has_scope_decl(node.body) or _has_scope_decl(node.orelse) \
                 or _has_nonname_binding(node.body) \
                 or _has_nonname_binding(node.orelse):
             return node        # global/nonlocal/import/def in a branch
-        mod = sorted(body_names)
+        # mod is the UNION: a name assigned in one branch only is carried
+        # through the other unchanged (its incoming value is the branch
+        # result) — names with no prior binding must be assigned by BOTH
+        # branches to functionalize under trace (checked at runtime via
+        # `both`)
+        mod = sorted(body_names | else_names)
+        both = tuple(sorted(body_names & else_names))
         name_t = self._next("true")
         name_f = self._next("false")
         args = ast.arguments(posonlyargs=[], args=[ast.arg(arg=m)
@@ -163,7 +416,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         args=[node.test, _load(name_t), _load(name_f),
                               ast.Tuple(elts=[_load(m) for m in mod],
                                         ctx=ast.Load()),
-                              ast.Constant(value=tuple(mod))],
+                              _const(tuple(mod)), _const(both)],
                         keywords=[])
         if mod:
             assign = ast.Assign(
@@ -201,7 +454,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         args=[_load(name_c), _load(name_b),
                               ast.Tuple(elts=[_load(m) for m in mod],
                                         ctx=ast.Load()),
-                              ast.Constant(value=tuple(mod))],
+                              _const(tuple(mod))],
                         keywords=[])
         assign = ast.Assign(
             targets=[ast.Tuple(elts=[_store(m) for m in mod],
@@ -210,43 +463,207 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [_undef_guard(m) for m in mod] + [fn_c, fn_b, assign]
 
 
-def __jst_if__(test, true_fn, false_fn, vals, names):
-    from ..core.tensor import Tensor, _is_tracer
-    raw = test._data if isinstance(test, Tensor) else test
+# ---------------------------------------------------------------------------
+# runtime dispatch helpers
+# ---------------------------------------------------------------------------
+
+
+def _raw(x):
+    from ..core.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+def __jst_if__(test, true_fn, false_fn, vals, names, both=()):
+    from ..core.tensor import _is_tracer
+    raw = _raw(test)
     # ONLY tracers take the functional branch: an eager concrete Tensor
     # keeps exact python semantics (one branch runs, side effects intact)
     if _is_tracer(raw):
         from ..static import nn as snn
-        # names with no prior binding carry the sentinel; both branches
-        # assign them (they never read the incoming value), so hand the
-        # tracer a benign zero instead of a non-JAX object
-        vals = tuple(0 if v is _UNDEF else v for v in vals)
-        return snn.cond(test, true_fn, false_fn, *vals)
+        # names with no prior binding carry the sentinel; when both
+        # branches assign them (they never read the incoming value) hand
+        # the tracer a benign zero — otherwise the structures of the two
+        # branch results cannot match
+        clean = []
+        for n, v in zip(names, vals):
+            if v is _UNDEF:
+                if n not in both:
+                    raise NameError(
+                        f"variable {n!r} is assigned in only one branch "
+                        "of a tensor-dependent if and has no value "
+                        "before it; initialize it before the if so both "
+                        "branches produce the same structure")
+                clean.append(0)
+            else:
+                clean.append(v)
+        try:
+            return snn.cond(test, true_fn, false_fn, *clean)
+        except TypeError as e:
+            if "pytree structure" not in str(e):
+                raise
+            # Structure mismatch — typically a return-transform carry
+            # whose initial value is None on one side and a tensor on the
+            # other. Lower as inline-both-branches + elementwise select
+            # (what XLA does for cheap conds anyway); None promotes to
+            # zeros, which every LIVE path overwrites under its flag
+            # guard before the final return.
+            return _inline_select(test, true_fn, false_fn, clean, e)
     return true_fn(*vals) if test else false_fn(*vals)
 
 
+def _inline_select(test, true_fn, false_fn, clean, orig_err):
+    from ..core.tensor import Tensor, apply
+    import jax.numpy as jnp
+    outs_t = true_fn(*clean)
+    outs_f = false_fn(*clean)
+    if not isinstance(outs_t, tuple):
+        outs_t, outs_f = (outs_t,), (outs_f,)
+
+    def is_val(x):
+        return isinstance(x, (Tensor, bool, int, float, complex)) \
+            or hasattr(x, "dtype")
+
+    out = []
+    for t, f in zip(outs_t, outs_f):
+        if t is None and f is None:
+            out.append(None)
+            continue
+        if not ((is_val(t) or t is None) and (is_val(f) or f is None)):
+            raise TypeError(
+                "tensor-dependent `if`: the two paths produce "
+                f"incompatible values ({type(t).__name__} vs "
+                f"{type(f).__name__}); use paddle.static.nn.cond with "
+                "matching branch structures, or jnp.where for "
+                "elementwise selects.\n\noriginal error: "
+                + str(orig_err))
+
+        def sel(p, a, b):
+            if a is None:
+                a = jnp.zeros_like(b)
+            if b is None:
+                b = jnp.zeros_like(a)
+            return jnp.where(p, a, b)
+
+        args = [x for x in (test, t, f) if x is not None]
+        if t is None:
+            out.append(apply(lambda p, b: sel(p, None, b), *args,
+                             name="jst_select"))
+        elif f is None:
+            out.append(apply(lambda p, a: sel(p, a, None), *args,
+                             name="jst_select"))
+        else:
+            out.append(apply(sel, *args, name="jst_select"))
+    return tuple(out)
+
+
 def __jst_while__(cond_fn, body_fn, vals, names):
-    from ..core.tensor import Tensor, _is_tracer
-    undef = [n for n, v in zip(names, vals) if v is _UNDEF]
-    first = cond_fn(*vals)
-    raw = first._data if isinstance(first, Tensor) else first
-    if _is_tracer(raw):
-        if undef:
-            raise NameError(
-                f"loop variable(s) {undef} are assigned inside a "
-                "tensor-dependent while but have no value before it; "
-                "lax.while_loop carries need an initial binding — "
-                "initialize them before the loop")
-        from ..static import nn as snn
-        out = snn.while_loop(cond_fn, body_fn, list(vals))
-        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
-    while bool(first):
-        vals = body_fn(*vals)
+    from ..core.tensor import _is_tracer
+    vals = tuple(vals)
+    while True:
         first = cond_fn(*vals)
-    # after a zero-iteration loop, inside-only names stay the _Unbound
-    # sentinel: carrying/reassigning it is fine, USING it raises a clear
-    # NameError (python's unbound-local contract)
-    return tuple(vals)
+        raw = _raw(first)
+        if _is_tracer(raw):
+            # the condition is traced — either from the first evaluation
+            # or because a break/return flag became traced mid-loop (set
+            # inside a lax.cond); hand the CURRENT carries to
+            # lax.while_loop. Names with no binding before the loop
+            # (_UNDEF) are loop-LOCAL temporaries, not carries: the body
+            # receives the sentinel and must write before reading (a
+            # read raises the sentinel's clear NameError); their
+            # post-loop value stays unbound, as in python after a
+            # zero-iteration loop.
+            live = [i for i, v in enumerate(vals) if v is not _UNDEF]
+            from ..static import nn as snn
+            if len(live) == len(vals):
+                out = snn.while_loop(cond_fn, body_fn, list(vals))
+                return tuple(out) if isinstance(out, (list, tuple)) \
+                    else (out,)
+
+            def full(live_vals):
+                it = iter(live_vals)
+                return [next(it) if i in set(live) else _UNDEF
+                        for i in range(len(vals))]
+
+            def cond2(*lv):
+                return cond_fn(*full(lv))
+
+            def body2(*lv):
+                out = body_fn(*full(lv))
+                return tuple(out[i] for i in live)
+
+            out = snn.while_loop(cond2, body2,
+                                 [vals[i] for i in live])
+            out = list(out) if isinstance(out, (list, tuple)) else [out]
+            it = iter(out)
+            return tuple(next(it) if i in set(live) else _UNDEF
+                         for i in range(len(vals)))
+        if not bool(first):
+            # after a zero-iteration loop, inside-only names stay the
+            # _Unbound sentinel: carrying/reassigning it is fine, USING
+            # it raises a clear NameError (python's unbound-local
+            # contract)
+            return vals
+        vals = tuple(body_fn(*vals))
+
+
+def __jst_not__(x):
+    from ..core.tensor import Tensor, apply
+    if isinstance(x, Tensor) or hasattr(x, "dtype"):
+        import jax.numpy as jnp
+        return apply(jnp.logical_not, x, name="jst_not")
+    return not x
+
+
+def _jst_bool2(op_name, jnp_op, a, b):
+    from ..core.tensor import Tensor, apply
+    if isinstance(a, Tensor) or isinstance(b, Tensor) \
+            or hasattr(a, "dtype") or hasattr(b, "dtype"):
+        import jax.numpy as jnp
+        return apply(lambda x, y: jnp_op(jnp.asarray(x, bool),
+                                         jnp.asarray(y, bool)),
+                     a, b, name=op_name)
+    return None
+
+
+def __jst_and__(a, b):
+    import jax.numpy as jnp
+    out = _jst_bool2("jst_and", jnp.logical_and, a, b)
+    # NOTE: tensor operands evaluate both sides (no short circuit) — the
+    # lax lowering cannot skip either anyway
+    return (a and b) if out is None else out
+
+
+def __jst_or__(a, b):
+    import jax.numpy as jnp
+    out = _jst_bool2("jst_or", jnp.logical_or, a, b)
+    return (a or b) if out is None else out
+
+
+def __jst_range3__(*args):
+    """Normalize range endpoints WITHOUT constructing range() — tensor
+    endpoints stay tensors and drive a lax.while_loop."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args
+
+
+def __jst_range_cont__(i, stop, step):
+    from ..core.tensor import Tensor, apply
+    if isinstance(i, Tensor) or isinstance(stop, Tensor) \
+            or isinstance(step, Tensor) or hasattr(i, "dtype") \
+            or hasattr(stop, "dtype") or hasattr(step, "dtype"):
+        import jax.numpy as jnp
+
+        def f(iv, sv, st):
+            return jnp.where(st > 0, iv < sv, iv > sv)
+
+        return apply(f, i, stop, step, name="jst_range_cont")
+    return i < stop if step > 0 else i > stop
+
+
+# ---------------------------------------------------------------------------
 
 
 def ast_transform(func: Callable) -> Optional[Callable]:
@@ -265,8 +682,13 @@ def ast_transform(func: Callable) -> Optional[Callable]:
     fn_def = tree.body[0]
     if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
+    if _contains(fn_def.body, (ast.Yield, ast.YieldFrom)):
+        return None                      # generators keep python semantics
     fn_def.decorator_list = []           # avoid re-applying @to_static
     try:
+        tree = _ForToWhile().visit(tree)
+        _transform_returns(fn_def)
+        tree = _BreakContinue().visit(tree)
         new_tree = _ControlFlowTransformer().visit(tree)
         ast.fix_missing_locations(new_tree)
         # execute against the function's LIVE module globals so late-bound
@@ -276,6 +698,11 @@ def ast_transform(func: Callable) -> Optional[Callable]:
         globs.setdefault("__jst_if__", __jst_if__)
         globs.setdefault("__jst_while__", __jst_while__)
         globs.setdefault("__jst_undef__", _UNDEF)
+        globs.setdefault("__jst_not__", __jst_not__)
+        globs.setdefault("__jst_and__", __jst_and__)
+        globs.setdefault("__jst_or__", __jst_or__)
+        globs.setdefault("__jst_range3__", __jst_range3__)
+        globs.setdefault("__jst_range_cont__", __jst_range_cont__)
         code = compile(new_tree,
                        filename=f"<dy2static {func.__qualname__}>",
                        mode="exec")

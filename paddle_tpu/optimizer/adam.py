@@ -14,12 +14,13 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None):
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self._use_multi_tensor = use_multi_tensor
 
     def _init_slot(self, param):
         m = jnp.zeros(param.shape, jnp.float32)
@@ -45,13 +46,18 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision, name)
+                         None, grad_clip, lazy_mode, multi_precision,
+                         use_multi_tensor, name)
         self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
             else getattr(weight_decay, "coeff", 0.0)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
+        if apply_decay_param_fun is not None:
+            # per-name decay decisions don't batch over stacked groups
+            self._use_multi_tensor = False
 
     def _update(self, param, grad, slots, lr, t):
         new_param, new_slots = super()._update(param, grad, slots, lr, t)
@@ -86,6 +92,8 @@ class AdamW(Adam):
 
 class Lamb(Optimizer):
     """reference: operators/optimizers/lamb_op.cc."""
+
+    _mt_fusable = False   # per-param trust ratio (norms) can't batch
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,

@@ -11,9 +11,11 @@ __all__ = ["SGD", "Momentum"]
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, multi_precision=False, name=None):
+                 grad_clip=None, multi_precision=False,
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
+        self._use_multi_tensor = use_multi_tensor
 
     def _init_slot(self, param):
         return ()
@@ -29,12 +31,14 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, rescale_grad=1.0, name=None):
+                 multi_precision=False, rescale_grad=1.0,
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self.momentum = momentum
         self.use_nesterov = use_nesterov
         self.rescale_grad = rescale_grad
+        self._use_multi_tensor = use_multi_tensor
 
     def _init_slot(self, param):
         return (jnp.zeros(param.shape, jnp.float32),)

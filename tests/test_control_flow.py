@@ -100,8 +100,40 @@ def test_model_with_cond_compiles():
     assert np.isfinite(np.asarray(out._data)).all()
 
 
+def test_early_return_on_tensor_condition_compiles():
+    """`if tensor: return a; return b` — the return transform (reference:
+    return_transformer.py) turns the early return into a flag+value carry
+    that compiles and matches eager select semantics."""
+    from paddle_tpu.jit import to_static
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:     # traced bool
+                return h * 2
+            return h
+
+    paddle.seed(0)
+    model = M()
+    model.eval()
+    ref_pos = model(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy()
+    ref_neg = model(paddle.to_tensor(-np.ones((2, 4), np.float32))).numpy()
+    to_static(model)
+    out_pos = model(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    out_neg = model(paddle.to_tensor(-np.ones((2, 4), np.float32)))
+    np.testing.assert_allclose(out_pos.numpy(), ref_pos, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out_neg.numpy(), ref_neg, rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_python_if_on_tensor_raises_guided_error():
-    """Python `if tensor:` inside a traced forward fails with framework
+    """A python `if tensor:` the AST pass cannot functionalize (here: an
+    import statement inside the branch) still fails with framework
     guidance naming static.nn.cond (not a bare jax error)."""
     from paddle_tpu.jit import to_static
 
@@ -113,7 +145,8 @@ def test_python_if_on_tensor_raises_guided_error():
         def forward(self, x):
             h = self.fc(x)
             if h.mean() > 0:     # traced bool -> concretization error
-                return h * 2
+                import math
+                h = h * math.e
             return h
 
     import jax.errors
@@ -266,3 +299,208 @@ def test_ast_late_bound_globals_and_fallbacks():
     # is only raised if the name never got bound — here x returns fine
     np.testing.assert_allclose(
         convert_to_static(while_undef_zero_iter)(x).numpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# dy2static loops: for/break/continue/return (reference: loop_transformer,
+# break_continue_transformer, return_transformer)
+# ---------------------------------------------------------------------------
+
+
+def test_ast_range_for_over_tensor_bound_compiles():
+    """`for i in range(t)` with a tensor bound lowers to lax.while_loop
+    under trace; matches python eagerly."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x * float(1.0)
+        return acc
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+    n = paddle.to_tensor(np.int32(4))
+    np.testing.assert_allclose(g(x, n).numpy(), 8.0)          # eager tensor
+
+    # traced: both args traced; loop count is data-dependent
+    from paddle_tpu.core.tensor import Tensor
+
+    def pure(xa, na):
+        return g(Tensor(xa), Tensor(na))._data
+
+    out = jax.jit(pure)(x._data, n._data)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    out5 = jax.jit(pure)(x._data, jax.numpy.asarray(np.int32(5)))
+    np.testing.assert_allclose(np.asarray(out5), 10.0)
+
+
+def test_ast_range_for_python_semantics():
+    """Plain python range loops keep exact semantics (incl. step and the
+    loop variable's final value)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        s = 0
+        for i in range(1, 10, 3):
+            s = s + i
+        return x * float(s), i
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    out, last = g(x)
+    np.testing.assert_allclose(out.numpy(), 12.0)   # 1+4+7
+    assert last == 7
+
+
+def test_ast_break_continue_in_while():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        i = 0
+        s = x * 0.0
+        while i < 10:
+            i = i + 1
+            if i == 3:
+                continue
+            if i > 5:
+                break
+            s = s + x * float(1.0)
+        return s, i
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    s, i = g(x)
+    np.testing.assert_allclose(s.numpy(), 4.0)      # i=1,2,4,5
+    assert int(i) == 6
+
+    # pure-python reference agrees
+    s_ref, i_ref = f(x)
+    np.testing.assert_allclose(s.numpy(), s_ref.numpy())
+
+
+def test_ast_break_on_tensor_condition_compiles():
+    """break guarded by a TRACED condition: the loop starts python-side,
+    the flag becomes traced inside lax.cond, and __jst_while__ hands off
+    to lax.while_loop mid-flight."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, limit):
+        s = x * 0.0
+        i = x.sum() * 0.0        # tensor counter (no closure imports)
+        while i < 100:
+            s = s + x
+            i = i + 1
+            if s.sum() > limit:
+                break
+        return s
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    lim = paddle.to_tensor(np.float32(5.0))
+    # eager: sum hits 6 after 3 iters (2 elements * 3)
+    np.testing.assert_allclose(g(x, lim).numpy(), 3.0)
+
+    def pure(xa, la):
+        return g(Tensor(xa), Tensor(la))._data
+
+    out = jax.jit(pure)(x._data, lim._data)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_ast_return_inside_loop():
+    """A return inside a while lowers via the return-flag transform and
+    matches python semantics eagerly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        i = 0
+        while i < 10:
+            x = x * 2.0
+            if float(x.sum()) > 10:
+                return x, i
+            i = i + 1
+        return x, -1
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    out, i = g(x)
+    # doubles: 2,4,8 -> sums 4,8,16; stops at 16
+    np.testing.assert_allclose(out.numpy(), 8.0)
+    assert int(i) == 2
+    out_ref, i_ref = f(paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), out_ref.numpy())
+    assert int(i) == int(i_ref)
+
+
+def test_ast_single_sided_if_on_tensor():
+    """`if cond: x = f(x)` (no else) functionalizes: the false path
+    carries the incoming value through."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        if x.mean() > 0:
+            x = x * 3.0
+        return x
+
+    g = convert_to_static(f)
+    xp = paddle.to_tensor(np.ones((2,), np.float32))
+    xn = paddle.to_tensor(-np.ones((2,), np.float32))
+
+    def pure(xa):
+        return g(Tensor(xa))._data
+
+    np.testing.assert_allclose(np.asarray(jax.jit(pure)(xp._data)), 3.0)
+    np.testing.assert_allclose(np.asarray(jax.jit(pure)(xn._data)), -1.0)
+
+
+def test_ast_decode_loop_to_static():
+    """VERDICT r3 done-criterion: a python-for greedy decode loop compiles
+    via @to_static and matches eager."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit import to_static
+
+    class TinyDecoder(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(16, 8)
+            self.proj = nn.Linear(8, 16)
+
+        def forward(self, ids, steps):
+            # greedy continuation: feed back argmax `steps` times
+            h = self.emb(ids).mean(axis=1)
+            outs = h * 0.0
+            for i in range(steps):
+                logits = self.proj(h)
+                nxt = logits.argmax(axis=-1)
+                h = 0.5 * h + 0.5 * self.emb(nxt)
+                outs = outs + h
+            return outs
+
+    paddle.seed(0)
+    m = TinyDecoder()
+    m.eval()
+    ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+    ref = m(ids, 4).numpy()
+    to_static(m)
+    out = m(ids, 4)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)

@@ -36,7 +36,7 @@ def timed(fn, args, iters=8):
 
 
 def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
-          grads_only=False):
+          grads_only=False, mt=False):
     """remat: None | 'full' | 'dots' (selective: save dot outputs)."""
     import jax
     import paddle_tpu as paddle
@@ -96,13 +96,15 @@ def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
         return (lambda i, la: g(params, i, la)), (ids, labels)
 
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                weight_decay=0.01)
+                weight_decay=0.01, use_multi_tensor=mt)
     step = TrainStep(model, loss_fn, opt)
     return step, (ids, labels)
 
 
 MODES = {
     "base": dict(),
+    "base_mt": dict(mt=True),
+    "b12_mt": dict(B=12, mt=True),
     "fwdonly": dict(fwd_only=True),
     "gradsonly": dict(grads_only=True),
     "nodrop": dict(drop=0.0),

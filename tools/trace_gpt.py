@@ -1,0 +1,71 @@
+"""Dev tool: capture a jax.profiler trace of the GPT-2 345M train step and
+print the top XLA ops by total device time."""
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import collections
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as paddle
+    paddle.set_flags({"tpu_matmul_precision": "default"})
+    sys.argv = [sys.argv[0]]
+    from prof_gpt import build, _sync
+
+    step, args = build()
+    _sync(step(*args))
+    for _ in range(2):
+        out = step(*args)
+    _sync(out)
+
+    tdir = "/tmp/gpt_trace"
+    os.system(f"rm -rf {tdir}")
+    with jax.profiler.trace(tdir):
+        for _ in range(3):
+            out = step(*args)
+        _sync(out)
+
+    paths = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        log("no trace captured")
+        return
+    with gzip.open(paths[0], "rt") as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents", [])
+    # find the XLA Ops / XLA TPU op lanes
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    op_pids = {p for p, n in pid_names.items()
+               if "XLA" in n or "TensorFlow Op" in n or "/device" in n}
+    import re
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in op_pids:
+            name = e.get("name", "")
+            if name.startswith("jit_") or name.isdigit():
+                continue                  # parent region events
+            base = re.sub(r"[.\d_]+$", "", name) or name
+            tot[base] += e.get("dur", 0)
+            cnt[base] += 1
+    log(f"lanes: {sorted(set(pid_names.values()))}")
+    total_us = sum(tot.values())
+    log(f"total device op time: {total_us/3/1e3:.1f} ms/step over 3 steps")
+    for name, us in tot.most_common(30):
+        log(f"{us/3/1e3:8.2f} ms/step ({us/total_us*100:4.1f}%)  "
+            f"x{cnt[name]:4d}  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()
