@@ -126,7 +126,9 @@ _apply_cc()
 
 
 def disable_static(place=None):
-    """Parity shim: this framework is always eager-first."""
+    """Return to eager (dygraph) mode — the framework default."""
+    from .static import _static_mode
+    _static_mode[0] = False
     return None
 
 

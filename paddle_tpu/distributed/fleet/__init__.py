@@ -25,7 +25,7 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
 __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
            "InMemoryDataset", "QueueDataset",
            "CommunicateTopology", "get_hybrid_communicate_group",
-           "distributed_model", "distributed_optimizer",
+           "distributed_model", "distributed_optimizer", "reset",
            "worker_index", "worker_num", "is_first_worker",
            "barrier_worker", "init_is_called",
            "save_persistables", "load_persistables"]
@@ -189,11 +189,30 @@ def load_persistables(obj, dirname: str):
     return obj
 
 
+def reset():
+    """Tear down fleet state (tests / re-init). The reference has no such
+    API because its strategy is scoped to distributed_optimizer; ours is
+    too (see below), but the topology/mesh globals still need a reset."""
+    _fleet_state["initialized"] = False
+    _fleet_state["strategy"] = None
+    set_hybrid_communicate_group(None)
+    env.reset()
+
+
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
-    """reference: fleet_base.py:830 — meta-optimizer chain; TPU-native: the
-    optimizer is returned with the hybrid context attached (grad clip psums
-    over mp/pp groups are wired by the meta_parallel engines)."""
-    if strategy is not None:
-        _fleet_state["strategy"] = strategy
+    """reference: fleet_base.py:830 — the ONLY boundary where a
+    DistributedStrategy changes training semantics. The meta-optimizer
+    chain becomes a strategy SNAPSHOT attached to the returned optimizer:
+    TrainStep reads gradient-merge / localsgd config exclusively from
+    ``optimizer._fleet_strategy``, so a bare optimizer (never passed
+    through here) is never rewired by a prior ``fleet.init`` — matching
+    the reference, where an un-wrapped optimizer ignores the strategy.
+    """
+    snap = strategy if strategy is not None else _strategy()
+    # snapshot (deep copy): later mutations of the user's strategy object
+    # must not retroactively change an already-built optimizer
+    frozen = DistributedStrategy()
+    frozen.__dict__["_config"] = snap.to_dict()
+    optimizer._fleet_strategy = frozen
     optimizer._hybrid_context = get_hybrid_communicate_group()
     return optimizer

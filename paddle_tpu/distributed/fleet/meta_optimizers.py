@@ -98,20 +98,32 @@ class LocalSGDTrainStep:
 
             def compute_loss(pp):
                 tensors = [Tensor(b) for b in batch]
+                new_bufs = dict(bufs)
                 with trace_rng(key), no_grad():
-                    with bind(layer, pp, dict(bufs)):
+                    with bind(layer, pp, new_bufs):
                         loss = loss_fn(layer, *tensors)
                 arr = loss._data if isinstance(loss, Tensor) else loss
-                return arr.astype(jnp.float32)
+                return arr.astype(jnp.float32), new_bufs
 
-            loss, grads = jax.value_and_grad(compute_loss)(p)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(p)
             new_p, new_st = opt.apply_gradients(p, grads, st, lr, t)
             new_p_rep = {k: v[None] for k, v in new_p.items()}
             new_st_rep = jax.tree_util.tree_map(
                 lambda a: a[None] if hasattr(a, "ndim") else a, new_st)
+            # buffer updates (BN/IN running stats) are averaged across
+            # replicas every step — the per-replica batches differ, so the
+            # mean is the stats over the union batch (SyncBN-flavoured;
+            # the reference's LocalSGD leaves BN stats per-replica and
+            # broadcasts rank 0's at the end, which silently discards
+            # k-1/k of the statistics)
+            new_bufs = {
+                k: jax.lax.pmean(v, axis)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for k, v in new_bufs.items()}
             # mean replica loss for reporting
             loss = jax.lax.pmean(loss, axis)
-            return new_p_rep, new_st_rep, loss[None]
+            return new_p_rep, new_st_rep, new_bufs, loss[None]
 
         pspec = {k: P(axis, *([None] * v.ndim))
                  for k, v in params0.items()}
@@ -128,7 +140,7 @@ class LocalSGDTrainStep:
         def make_local(bspecs):
             in_specs = (pspec, _P(), stspec, _P(), _P(), _P(),
                         list(bspecs))
-            out_specs = (pspec, stspec, _P(axis))
+            out_specs = (pspec, stspec, _P(), _P(axis))
             try:
                 sm = jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False)
@@ -176,21 +188,23 @@ class LocalSGDTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = self._make_rng("localsgd")
-        self.params, self.opt_state, loss = jitted(
+        self.params, self.opt_state, self.buffers, loss = jitted(
             self.params, self.buffers, self.opt_state, lr, t, key, rep)
-        loss_val = float(loss[0])
-        if self._loss0 is None:
-            self._loss0 = max(loss_val, 1e-12)
+        # host-sync the loss ONLY when the AdaComm schedule needs it — a
+        # per-step float() would serialize dispatch between local steps
+        if self.adaptive and self._loss0 is None:
+            self._loss0 = max(float(loss[0]), 1e-12)
         if self.step_count % self.k_steps == 0:
             self.params = self._sync(self.params)
             if self.adaptive:
                 # AdaComm: k_t = ceil(k_0 * sqrt(F(w_t) / F(w_0)))
                 import math
+                loss_val = float(loss[0])
                 k = math.ceil(self._k0
                               * math.sqrt(max(loss_val, 1e-12)
                                           / self._loss0))
                 self.k_steps = min(max(k, self.min_k), self.max_k)
-        return Tensor(jnp.asarray(loss_val))
+        return Tensor(loss[0])
 
     def sync_to_layer(self):
         """Average replicas and write back into the Layer."""

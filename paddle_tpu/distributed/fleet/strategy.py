@@ -84,12 +84,12 @@ class DistributedStrategy:
         cfg = self.__dict__["_config"]
         if name not in cfg:
             raise AttributeError(f"DistributedStrategy has no field {name!r}")
-        # localsgd is implemented (reference:
-        # fleet/meta_optimizers/localsgd_optimizer.py): build the train
-        # step with distributed.fleet.meta_optimizers.LocalSGDTrainStep,
-        # which runs k local steps per replica (shard_map, zero ICI
-        # traffic) then one parameter pmean; adaptive=True gives the
-        # AdaComm schedule.
+        # localsgd/adaptive_localsgd are wired end-to-end (reference:
+        # fleet/meta_optimizers/localsgd_optimizer.py): an optimizer
+        # wrapped by fleet.distributed_optimizer under this strategy makes
+        # TrainStep build a LocalSGDTrainStep — k local steps per replica
+        # (shard_map, zero ICI traffic) then one parameter pmean;
+        # adaptive=True gives the AdaComm schedule.
         if name == "dgc" and value:
             raise NotImplementedError(
                 "dgc (deep gradient compression) is not implemented: it "

@@ -1,10 +1,16 @@
 """High-level Model API (reference: python/paddle/hapi/model.py —
-Model:906, fit:1556, DynamicGraphAdapter:666).
+Model:906, fit:1556, DynamicGraphAdapter:666, StaticGraphAdapter:247).
 
-TPU-native: `prepare()` builds a jitted TrainStep (forward+loss+grad+opt in
-one compiled program with donation) — the analogue of the reference's
-static-graph adapter, without a Program in sight. `fit` drives DataLoaders
-and callbacks around it.
+TPU-native: in dynamic mode `prepare()` builds a jitted TrainStep
+(forward+loss+grad+opt in one compiled program with donation); under
+``paddle.enable_static()`` it builds a RECORDED static.Program driven by
+``Executor.run`` — the working analogue of the reference's
+StaticGraphAdapter (hapi/model.py:247: prepare builds feed/fetch
+programs, fit runs them on the executor). With ``fleet.init`` active the
+dynamic path becomes fleet-distributed: the train step is laid out over
+the hybrid mesh with the batch sharded on the dp axis
+(reference: hapi/model.py:666 DynamicGraphAdapter wrapping the network
+in fleet.distributed_model).
 """
 
 from __future__ import annotations
@@ -21,15 +27,121 @@ from ..metric import Metric
 from . import callbacks as cbks_mod
 
 
+class StaticGraphAdapter:
+    """Build + run recorded Programs for a hapi Model (reference:
+    hapi/model.py:247 — _make_program builds the train program from the
+    Model's InputSpecs, clone(for_test=True) derives the eval program,
+    run() feeds/fetches through the Executor)."""
+
+    def __init__(self, model: "Model"):
+        from .. import static
+        from ..jit.input_spec import InputSpec
+
+        if not model._inputs:
+            raise ValueError(
+                "static-mode Model needs `inputs=[InputSpec(...)]` at "
+                "construction (the recorded Program's placeholders come "
+                "from them — reference hapi/model.py:906 makes the same "
+                "demand of its static adapter)")
+
+        def specs(raw, prefix):
+            out = []
+            for i, s in enumerate(raw or []):
+                if not isinstance(s, InputSpec):
+                    s = InputSpec(s.shape, getattr(s, "dtype", "float32"))
+                out.append((s.name or f"{prefix}{i}", s))
+            return out
+
+        self._in_specs = specs(model._inputs, "x")
+        self._lab_specs = specs(model._labels, "label")
+        self.model = model
+        self._exe = static.Executor()
+
+        self.train_prog = static.Program()
+        startup = static.Program()
+        model.network.train()
+        with static.program_guard(self.train_prog, startup):
+            ins = [static.data(n, list(s.shape), s.dtype)
+                   for n, s in self._in_specs]
+            labs = [static.data(n, list(s.shape), s.dtype)
+                    for n, s in self._lab_specs]
+            outs = model.network(*ins)
+            self._outputs = list(outs) if isinstance(outs, (list, tuple)) \
+                else [outs]
+            self._loss_var = None
+            if model._loss is not None and labs:
+                self._loss_var = model._loss(self._outputs[0], labs[0])
+                if model._optimizer is not None:
+                    model._optimizer.minimize(self._loss_var)
+        # eval twin: train-only ops (dropout, BN batch stats) swapped for
+        # their recorded eval variants, writes stripped, optimizer dropped
+        self.test_prog = self.train_prog.clone(for_test=True)
+
+    def _feed(self, xs, labels=None):
+        feed = {}
+        batch = None
+        for (name, spec), v in zip(self._in_specs, xs):
+            arr = np.asarray(v._data if isinstance(v, Tensor) else v)
+            feed[name] = arr
+            batch = arr.shape[0] if arr.ndim else None
+        labels = labels or []
+        for i, (name, spec) in enumerate(self._lab_specs):
+            if i < len(labels):
+                v = labels[i]
+                feed[name] = np.asarray(
+                    v._data if isinstance(v, Tensor) else v)
+            else:
+                # predict path: label placeholders must still be fed (the
+                # Executor refuses silent build-time zeros); the fetch set
+                # doesn't read them, XLA dead-code-eliminates the loss
+                shape = tuple(batch if (d is None or int(d) < 1) else int(d)
+                              for d in spec.shape) or ()
+                feed[name] = np.zeros(shape, spec.dtype)
+        return feed
+
+    def train_batch(self, xs, labels=None):
+        (lv,) = self._exe.run(self.train_prog,
+                              feed=self._feed(xs, labels),
+                              fetch_list=[self._loss_var])
+        return [float(lv)]
+
+    def eval_batch(self, xs, labels=None):
+        # without labels the loss would be computed against the zero-fill
+        # placeholder feed — return no loss, as the dynamic path does
+        want_loss = self._loss_var is not None and bool(labels)
+        fetch = ([self._loss_var] if want_loss else []) + self._outputs
+        res = self._exe.run(self.test_prog, feed=self._feed(xs, labels),
+                            fetch_list=fetch)
+        metrics = []
+        if want_loss:
+            metrics.append(float(res[0]))
+            res = res[1:]
+        if labels:
+            for m in self.model._metrics:
+                corr = m.compute(Tensor(res[0]), labels[0]
+                                 if isinstance(labels[0], Tensor)
+                                 else Tensor(np.asarray(labels[0])))
+                m.update(corr)
+        return metrics
+
+    def predict_batch(self, xs):
+        res = self._exe.run(self.test_prog, feed=self._feed(xs),
+                            fetch_list=self._outputs)
+        return [np.asarray(r) for r in res]
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
-        self._inputs = inputs
-        self._labels = labels
+        self._inputs = inputs if inputs is None or isinstance(
+            inputs, (list, tuple)) else [inputs]
+        self._labels = labels if labels is None or isinstance(
+            labels, (list, tuple)) else [labels]
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
         self._train_step = None
+        self._adapter: Optional[StaticGraphAdapter] = None
         self.stop_training = False
 
     # ------------------------------------------------------------------
@@ -41,6 +153,13 @@ class Model:
         else:
             self._metrics = []
 
+        import paddle_tpu as paddle
+        if not paddle.in_dynamic_mode():
+            # static mode: recorded Program + Executor (the reference's
+            # StaticGraphAdapter path, hapi/model.py:247)
+            self._adapter = StaticGraphAdapter(self)
+            return self
+
         if optimizer is not None and loss is not None:
             loss_layer = loss
 
@@ -50,7 +169,23 @@ class Model:
                 out = net(*xs)
                 return loss_layer(out, y)
 
-            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+            # fleet-distributed fit (reference: hapi/model.py:666 wraps
+            # the network AND optimizer per parallel mode): with an active
+            # hybrid mesh the train step is SPMD over it, batch sharded on
+            # dp; the optimizer goes through fleet.distributed_optimizer
+            # so the active strategy (gradient_merge, localsgd) applies
+            from ..distributed import fleet
+            if fleet.init_is_called():
+                from jax.sharding import PartitionSpec as P
+                hcg = fleet.get_hybrid_communicate_group()
+                if not hasattr(optimizer, "_fleet_strategy"):
+                    optimizer = fleet.distributed_optimizer(optimizer)
+                self._train_step = TrainStep(
+                    self.network, loss_fn, optimizer, mesh=hcg.mesh,
+                    data_spec=P("dp"))
+            else:
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             optimizer)
         return self
 
     # ------------------------------------------------------------------
@@ -69,6 +204,9 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        if self._adapter is not None:
+            return self._adapter.train_batch(list(inputs),
+                                             list(labels) if labels else [])
         batch = list(inputs) + (list(labels) if labels else [])
         self.network.train()
         loss = self._train_step(*batch)
@@ -77,6 +215,12 @@ class Model:
     @no_grad()
     def eval_batch(self, inputs, labels=None):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._adapter is not None:
+            labels_l = labels if labels is None or isinstance(
+                labels, (list, tuple)) else [labels]
+            return self._adapter.eval_batch(list(inputs),
+                                            list(labels_l) if labels_l
+                                            else [])
         self.network.eval()
         if self._train_step is not None:
             self._train_step.sync_to_layer()
@@ -96,6 +240,8 @@ class Model:
     @no_grad()
     def predict_batch(self, inputs):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._adapter is not None:
+            return self._adapter.predict_batch(list(inputs))
         self.network.eval()
         if self._train_step is not None:
             self._train_step.sync_to_layer()

@@ -210,13 +210,22 @@ class _ForToWhile(ast.NodeTransformer):
             # instead of being unbound — the reference's loop transform
             # makes the same trade)
             _assign(tgt, _load(i)),
+            _assign(i, ast.BinOp(left=_load(i), op=ast.Sub(),
+                                 right=_load(step))),
         ]
-        body = ([_assign(tgt, _load(i))] + list(node.body)
-                + [_assign(i, ast.BinOp(left=_load(i), op=ast.Add(),
-                                        right=_load(step)))])
+        # the counter advances at the TOP of the body (i starts at
+        # start-step, the test looks one step ahead): a `continue` lowered
+        # by _BreakContinue guards every statement AFTER the flag set, and
+        # a trailing increment under that guard would never run again —
+        # the loop would spin forever
+        body = ([_assign(i, ast.BinOp(left=_load(i), op=ast.Add(),
+                                      right=_load(step))),
+                 _assign(tgt, _load(i))] + list(node.body))
         loop = ast.While(
-            test=_call("__jst_range_cont__", _load(i), _load(stop),
-                       _load(step)),
+            test=_call("__jst_range_cont__",
+                       ast.BinOp(left=_load(i), op=ast.Add(),
+                                 right=_load(step)),
+                       _load(stop), _load(step)),
             body=body, orelse=[])
         return prologue + [loop]
 
@@ -518,6 +527,12 @@ def _inline_select(test, true_fn, false_fn, clean, orig_err):
     outs_f = false_fn(*clean)
     if not isinstance(outs_t, tuple):
         outs_t, outs_f = (outs_t,), (outs_f,)
+    if len(outs_t) != len(outs_f):
+        raise TypeError(
+            "tensor-dependent `if`: the two paths produce a different "
+            f"number of values ({len(outs_t)} vs {len(outs_f)}); use "
+            "paddle.static.nn.cond with matching branch structures.\n\n"
+            "original error: " + str(orig_err))
 
     def is_val(x):
         return isinstance(x, (Tensor, bool, int, float, complex)) \

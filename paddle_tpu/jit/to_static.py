@@ -254,6 +254,54 @@ class TrainStep:
     eager inspection.
     """
 
+    def __new__(cls, layer=None, loss_fn=None, optimizer=None, *args,
+                **kwargs):
+        # fleet meta-optimizer dispatch (reference: strategy_compiler.py
+        # picks the meta-optimizer from the strategy attached at
+        # fleet.distributed_optimizer): a strategy snapshot carried by the
+        # optimizer selects the LocalSGD step implementation. Strategy is
+        # read ONLY from the optimizer — never from process globals — so
+        # a bare optimizer always gets the plain step.
+        strat = getattr(optimizer, "_fleet_strategy", None)
+        if cls is TrainStep and strat is not None and (
+                strat.localsgd or strat.adaptive_localsgd):
+            from ..distributed.fleet.meta_optimizers import LocalSGDTrainStep
+            from ..distributed.fleet.topology import (
+                get_hybrid_communicate_group)
+            hcg = get_hybrid_communicate_group()
+            if hcg is None:
+                raise RuntimeError(
+                    "strategy.localsgd requires fleet.init() first (the dp "
+                    "mesh axis hosts the per-replica parameter copies)")
+            if strat.gradient_merge:
+                raise NotImplementedError(
+                    "strategy combines localsgd with gradient_merge; the "
+                    "LocalSGD step does not accumulate gradients — pick "
+                    "one (the reference's meta-optimizer chain rejects "
+                    "this pairing too)")
+            # arguments the LocalSGD step cannot honor must fail loudly,
+            # not vanish (the silent-rewiring failure mode this dispatch
+            # exists to eliminate)
+            unsupported = {k: v for k, v in kwargs.items()
+                           if k not in ("mesh", "data_spec") and
+                           v is not None and v is not True}
+            if args or unsupported:
+                raise TypeError(
+                    "strategy.localsgd builds a LocalSGDTrainStep, which "
+                    f"does not accept {list(unsupported) or 'positional'} "
+                    "arguments (metrics_fn/zero_axis/grad_accum_*); "
+                    "construct distributed.fleet.meta_optimizers."
+                    "LocalSGDTrainStep directly for custom wiring")
+            adaptive = bool(strat.adaptive_localsgd)
+            cfg = (strat.adaptive_localsgd_configs if adaptive
+                   else strat.localsgd_configs)
+            k = int(cfg.get("init_k_steps" if adaptive else "k_steps", 1))
+            return LocalSGDTrainStep(
+                layer, loss_fn, optimizer,
+                kwargs.get("mesh") or hcg.mesh, k_steps=k,
+                axis="dp", adaptive=adaptive)
+        return super().__new__(cls)
+
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
                  metrics_fn: Optional[Callable] = None, donate: bool = True,
                  mesh=None, data_spec=None, zero_axis: Optional[str] = None,
@@ -265,14 +313,15 @@ class TrainStep:
         self.optimizer = optimizer
         self.metrics_fn = metrics_fn
         if grad_accum_steps is None:
-            # adopt fleet's gradient_merge strategy when active (reference:
-            # fleet/meta_optimizers/gradient_merge_optimizer.py); a
-            # misconfigured strategy must FAIL here, not silently train
-            # with k=1
+            # gradient merge comes ONLY from the strategy snapshot that
+            # fleet.distributed_optimizer attached to this optimizer
+            # (reference: gradient_merge_optimizer.py, applied by the
+            # meta-optimizer chain at the distributed_optimizer boundary).
+            # A bare optimizer is never silently rewired by fleet.init.
             grad_accum_steps = 1
-            from ..distributed.fleet import _strategy, init_is_called
-            if init_is_called() and _strategy().gradient_merge:
-                cfg = _strategy().gradient_merge_configs
+            strat = getattr(optimizer, "_fleet_strategy", None)
+            if strat is not None and strat.gradient_merge:
+                cfg = strat.gradient_merge_configs
                 grad_accum_steps = int(cfg["k_steps"])
                 if grad_accum_avg is None:
                     grad_accum_avg = bool(cfg.get("avg", True))

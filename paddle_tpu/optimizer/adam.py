@@ -11,33 +11,43 @@ __all__ = ["Adam", "AdamW", "Lamb", "Adamax", "Adadelta", "Adagrad", "RMSProp"]
 
 
 class Adam(Optimizer):
+    """``state_dtype="bfloat16"`` keeps the m/v slots in bf16 (compute
+    stays f32): halves optimizer-state HBM traffic AND footprint — the
+    TPU-native analogue of the reference's fused low-memory Adam variants
+    (operators/optimizers/adam_op.cu:1 multi-precision paths). bf16's
+    8-bit mantissa costs <0.5% relative error on the denominator; fine
+    for pretraining (loss-parity covered in tests/test_optimizer.py)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None):
+                 use_multi_tensor=False, state_dtype="float32", name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
         self._use_multi_tensor = use_multi_tensor
+        self._state_dtype = jnp.dtype(state_dtype)
 
     def _init_slot(self, param):
-        m = jnp.zeros(param.shape, jnp.float32)
-        v = jnp.zeros(param.shape, jnp.float32)
+        m = jnp.zeros(param.shape, self._state_dtype)
+        v = jnp.zeros(param.shape, self._state_dtype)
         return (m, v)
 
     def _update(self, param, grad, slots, lr, t):
         m, v = slots
         g = grad.astype(jnp.float32)
-        m = self.beta1 * m + (1 - self.beta1) * g
-        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        m = self.beta1 * m.astype(jnp.float32) + (1 - self.beta1) * g
+        v = self.beta2 * v.astype(jnp.float32) \
+            + (1 - self.beta2) * jnp.square(g)
         t_f = jnp.asarray(t, jnp.float32)
         bc1 = 1 - jnp.power(self.beta1, t_f)
         bc2 = 1 - jnp.power(self.beta2, t_f)
         lr_t = lr * jnp.sqrt(bc2) / bc1
         new_param = param.astype(jnp.float32) - lr_t * m / (jnp.sqrt(v) + self.epsilon)
-        return new_param, (m, v)
+        return new_param, (m.astype(self._state_dtype),
+                           v.astype(self._state_dtype))
 
 
 class AdamW(Adam):
@@ -47,10 +57,10 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None):
+                 use_multi_tensor=False, state_dtype="float32", name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         use_multi_tensor, name)
+                         use_multi_tensor, state_dtype, name)
         self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
             else getattr(weight_decay, "coeff", 0.0)
         self._apply_decay_param_fun = apply_decay_param_fun

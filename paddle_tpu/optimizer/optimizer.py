@@ -141,6 +141,15 @@ class Optimizer:
             grads = self._grad_clip(grads)
         gmap = self._mt_groups
         slots = state["__mt__"]
+        grouped = {k for names in gmap.values() for k in names}
+        if set(params) != grouped:
+            extra = sorted(set(params) - grouped)[:3]
+            gone = sorted(grouped - set(params))[:3]
+            raise ValueError(
+                "use_multi_tensor=True: the parameter dict no longer "
+                "matches the groups built at init_state (new: "
+                f"{extra}, missing: {gone}); call init_state again after "
+                "changing the parameter set")
         new_params, new_slots = {}, {}
         for gk, names in gmap.items():
             missing = [k for k in names if grads.get(k) is None]

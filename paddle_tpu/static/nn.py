@@ -51,6 +51,54 @@ def _as_scalar_pred(pred):
     return p.astype(bool)
 
 
+def _active_recorder():
+    from ..core.tensor import _static_recorders
+    return _static_recorders[-1] if _static_recorders else None
+
+
+def _subtrace(fn, arg_tensors):
+    """Trace ``fn(*arg_tensors)`` into a fresh sub-Program (the analogue of
+    the reference's sub-block build for conditional_block_op.cc:1 /
+    while_op.cc:1). Returns (sub_program, out_tensors, captured_leaf_ids):
+    leaf ids are tensors the branch READS from the enclosing scope
+    (parameters, intermediates) — they become explicit inputs of the
+    combined op so replay never bakes them as trace constants."""
+    from . import Program
+    from ..core.tensor import pop_static_recorder, push_static_recorder
+    sub = Program()
+    push_static_recorder(sub)
+    try:
+        out = fn(*arg_tensors)
+    finally:
+        pop_static_recorder()
+    if sub._mutated:
+        raise NotImplementedError(
+            "in-place buffer writes (BN running stats, QAT scales) inside "
+            "a recorded cond/while branch are not supported: the write "
+            "would be conditional on a traced predicate. Hoist the "
+            "stateful layer out of the branch, or run it in eval mode.")
+    was_seq = isinstance(out, (list, tuple))
+    outs = out if was_seq else [out]
+    arg_ids = {id(t) for t in arg_tensors}
+    leaves = [lid for lid in sub.leaf_ids() if lid not in arg_ids]
+    return sub, list(outs), leaves, was_seq
+
+
+def _merge_leaves(subs_and_leaves):
+    """Ordered union of captured-leaf ids across sub-programs; returns
+    (leaf_ids, leaf_tensors)."""
+    leaf_ids = list(dict.fromkeys(
+        lid for _, leaves in subs_and_leaves for lid in leaves))
+    tensors = []
+    for lid in leaf_ids:
+        for sub, _ in subs_and_leaves:
+            t = sub._tensors.get(lid)
+            if t is not None:
+                tensors.append(t)
+                break
+    return leaf_ids, tensors
+
+
 def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
     """Run true_fn() or false_fn() selected by a traced boolean scalar.
 
@@ -59,7 +107,16 @@ def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
     device. Branch outputs must match in structure/shape/dtype.
     Extra ``operands`` are passed to both branches (closure capture also
     works, as in the reference).
-    """
+
+    Under an active ``static.program_guard`` the cond records as ONE op
+    whose fn replays both branch sub-programs inside ``lax.cond`` — the
+    TPU-native analogue of the reference's conditional_block sub-block ops
+    (conditional_block_op.cc:1): a recorded Program containing a branch
+    replays under Executor.run, including gradient flow to captured
+    parameters (lax.cond is reverse-differentiable)."""
+    rec = _active_recorder()
+    if rec is not None:
+        return _recorded_cond(pred, true_fn, false_fn, operands)
     raw = [o._data if isinstance(o, Tensor) else o for o in operands]
 
     def tb(ops):
@@ -72,12 +129,69 @@ def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
     return wrap(out)
 
 
+def _recorded_cond(pred, true_fn, false_fn, operands):
+    from ..core.tensor import Tensor as _T, apply
+    ops = [o if isinstance(o, _T) else _T(jnp.asarray(o))
+           for o in operands]
+    sub_t, outs_t, leaves_t, seq_t = _subtrace(
+        lambda *a: true_fn(*a) if a else true_fn(), ops)
+    sub_f, outs_f, leaves_f, seq_f = _subtrace(
+        lambda *a: false_fn(*a) if a else false_fn(), ops)
+    if len(outs_t) != len(outs_f):
+        raise TypeError(
+            f"cond branches must return the same structure: true_fn gave "
+            f"{len(outs_t)} value(s), false_fn {len(outs_f)}")
+    leaf_ids, leaf_tensors = _merge_leaves(
+        [(sub_t, leaves_t), (sub_f, leaves_f)])
+    n_ops = len(ops)
+    op_ids = [id(t) for t in ops]
+
+    def branch(sub, out_tensors):
+        out_ids = [id(o) for o in out_tensors]
+
+        def run(arg):
+            op_vals, leaf_vals = arg
+            env = dict(zip(op_ids, op_vals))
+            env.update(zip(leaf_ids, leaf_vals))
+            env = sub._replay(env)
+            # an output can be a passthrough (env) or a branch-local
+            # constant (its recorded array)
+            return tuple(env.get(i, t._data)
+                         for i, t in zip(out_ids, out_tensors))
+        return run
+
+    single = not seq_t and len(outs_t) == 1
+
+    def combined(pred_raw, *vals):
+        op_vals = tuple(vals[:n_ops])
+        leaf_vals = tuple(vals[n_ops:])
+        res = jax.lax.cond(
+            _as_scalar_pred(pred_raw), branch(sub_t, outs_t),
+            branch(sub_f, outs_f), (op_vals, leaf_vals))
+        return res[0] if single else tuple(res)
+
+    res = apply(combined, pred, *ops, *leaf_tensors, name="static_cond")
+    return res
+
+
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
     """reference: control_flow.py:1045 while_loop (while_op sub-program).
     Maps to lax.while_loop: carried values must keep shape/dtype; the
-    condition returns a scalar bool tensor."""
+    condition returns a scalar bool tensor.
+
+    Under an active ``static.program_guard`` the loop records as ONE op
+    replaying the cond/body sub-programs inside ``lax.while_loop``
+    (reference: while_op.cc:1 runs the sub-block per iteration). Note
+    ``lax.while_loop`` is not reverse-differentiable: a recorded Program
+    may contain a while for inference/decode replay, but the loss of a
+    training Program must not depend on one (the reference's while_grad
+    has no XLA analogue; use a bounded `for`+`lax.scan` style loop via
+    dy2static for differentiable loops)."""
     is_seq = isinstance(loop_vars, (list, tuple))
     seq: Sequence = loop_vars if is_seq else [loop_vars]
+    rec = _active_recorder()
+    if rec is not None:
+        return _recorded_while(cond_fn, body_fn, seq, is_seq)
     raw = tuple(v._data if isinstance(v, Tensor) else jnp.asarray(v)
                 for v in seq)
 
@@ -99,6 +213,49 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
     out = jax.lax.while_loop(c, b, raw)
     wrapped = [wrap(o) for o in out]
     return wrapped if is_seq else wrapped[0]
+
+
+def _recorded_while(cond_fn, body_fn, seq, is_seq):
+    from ..core.tensor import Tensor as _T, apply, no_grad
+    vars_t = [v if isinstance(v, _T) else _T(jnp.asarray(v)) for v in seq]
+    sub_c, outs_c, leaves_c, _ = _subtrace(cond_fn, vars_t)
+    sub_b, outs_b, leaves_b, _ = _subtrace(body_fn, vars_t)
+    if len(outs_b) != len(vars_t):
+        raise ValueError(
+            f"while_loop body returned {len(outs_b)} values, expected "
+            f"{len(vars_t)} (loop_vars structure must be invariant)")
+    leaf_ids, leaf_tensors = _merge_leaves(
+        [(sub_c, leaves_c), (sub_b, leaves_b)])
+    n = len(vars_t)
+    var_ids = [id(v) for v in vars_t]
+    pred_t = outs_c[0]
+    body_out_ids = [id(o) for o in outs_b]
+
+    def combined(*vals):
+        carry0 = tuple(vals[:n])
+        leaf_vals = tuple(vals[n:])
+
+        def c(carry):
+            env = dict(zip(var_ids, carry))
+            env.update(zip(leaf_ids, leaf_vals))
+            env = sub_c._replay(env)
+            return _as_scalar_pred(env.get(id(pred_t), pred_t._data))
+
+        def b(carry):
+            env = dict(zip(var_ids, carry))
+            env.update(zip(leaf_ids, leaf_vals))
+            env = sub_b._replay(env)
+            return tuple(env.get(i, t._data)
+                         for i, t in zip(body_out_ids, outs_b))
+
+        return tuple(jax.lax.while_loop(c, b, carry0))
+
+    # lax.while_loop has no reverse-mode rule — keep the eager apply off
+    # the tape (matching the unrecorded path, whose outputs are detached)
+    with no_grad():
+        res = apply(combined, *vars_t, *leaf_tensors, name="static_while")
+    out = list(res) if isinstance(res, (tuple, list)) else [res]
+    return out if is_seq else out[0]
 
 
 def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None):

@@ -46,3 +46,18 @@ def _seed():
     snap = dict(dist_env._global)
     yield
     dist_env._global.update(snap)
+
+
+@pytest.fixture(autouse=True)
+def _fleet_isolation():
+    """fleet state must not leak between tests: whatever a test does to
+    the fleet globals (init, strategy attach) is rolled back to the
+    pre-test snapshot, so outcomes are order-independent while
+    module-scoped mesh fixtures (test_mp_layers) keep working."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import topology
+    state_snap = dict(fleet._fleet_state)
+    hcg_snap = topology.get_hybrid_communicate_group()
+    yield
+    fleet._fleet_state.update(state_snap)
+    topology.set_hybrid_communicate_group(hcg_snap)

@@ -1,0 +1,95 @@
+"""Bench regression gate (tools/check_bench.py) — the analogue of the
+reference's op-benchmark CI gate
+(/root/reference/tools/check_op_benchmark_result.py:1)."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_bench  # noqa: E402
+
+
+def _m(name, value, unit):
+    return {"metric": name, "value": value, "unit": unit,
+            "vs_baseline": 1.0}
+
+
+def test_throughput_regression_caught():
+    old = [_m("bert_tokens_per_sec", 160000.0, "tokens/s")]
+    new = [_m("bert_tokens_per_sec", 144000.0 * 0.99, "tokens/s")]  # -10.9%
+    problems = check_bench.compare(old, new, tolerance=0.10)
+    assert len(problems) == 1 and "bert_tokens_per_sec" in problems[0]
+
+
+def test_throughput_within_tolerance_ok():
+    old = [_m("bert_tokens_per_sec", 160000.0, "tokens/s")]
+    new = [_m("bert_tokens_per_sec", 152000.0, "tokens/s")]   # -5%
+    assert check_bench.compare(old, new, tolerance=0.10) == []
+
+
+def test_time_metric_direction():
+    """ms metrics regress when they GROW."""
+    old = [_m("lenet_ms_per_step", 100.0, "ms")]
+    slower = [_m("lenet_ms_per_step", 115.0, "ms")]
+    faster = [_m("lenet_ms_per_step", 60.0, "ms")]
+    assert check_bench.compare(old, slower, tolerance=0.10)
+    assert check_bench.compare(old, faster, tolerance=0.10) == []
+
+
+def test_disappeared_metric_flagged():
+    old = [_m("a", 1.0, "tokens/s"), _m("b", 2.0, "tokens/s")]
+    new = [_m("a", 1.0, "tokens/s")]
+    problems = check_bench.compare(old, new)
+    assert any("disappeared" in p for p in problems)
+
+
+def test_new_metric_not_gated():
+    old = [_m("a", 1.0, "tokens/s")]
+    new = [_m("a", 1.0, "tokens/s"), _m("brand_new", 5.0, "img/s")]
+    assert check_bench.compare(old, new) == []
+
+
+def test_parses_driver_record_shapes(tmp_path):
+    """Accepts the driver's BENCH_r{N}.json: parsed as single dict (r1-r4)
+    and as a list (r5+); scrapes the tail when parsed is absent."""
+    old_rec = {"n": 4, "rc": 0,
+               "parsed": _m("bert_tokens_per_sec", 160000.0, "tokens/s")}
+    new_rec = {"n": 5, "rc": 0,
+               "parsed": [_m("bert_tokens_per_sec", 100000.0, "tokens/s"),
+                          _m("gpt_tokens_per_sec", 40000.0, "tokens/s")]}
+    po = tmp_path / "old.json"
+    pn = tmp_path / "new.json"
+    po.write_text(json.dumps(old_rec))
+    pn.write_text(json.dumps(new_rec))
+    rc = check_bench.main([str(po), str(pn)])
+    assert rc == 1                                  # -37% regression
+
+    tail_rec = {"n": 3, "rc": 0, "tail":
+                'noise\n' + json.dumps(
+                    _m("bert_tokens_per_sec", 99000.0, "tokens/s")) + "\n"}
+    pt = tmp_path / "tail.json"
+    pt.write_text(json.dumps(tail_rec))
+    rc = check_bench.main([str(pt), str(pn)])      # 99k -> 100k: fine
+    assert rc == 0
+
+
+def test_cli_synthetic_10pct_regression(tmp_path):
+    """End-to-end CLI: a synthetic 10%+ regression exits 1."""
+    old = [_m("resnet50_imgs_per_sec", 1650.0, "img/s"),
+           _m("gpt_tokens_per_sec", 40000.0, "tokens/s")]
+    new = [_m("resnet50_imgs_per_sec", 1480.0, "img/s"),   # -10.3%
+           _m("gpt_tokens_per_sec", 40500.0, "tokens/s")]
+    po = tmp_path / "o.json"
+    pn = tmp_path / "n.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "check_bench.py"), str(po), str(pn)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "resnet50_imgs_per_sec" in proc.stdout
+    assert "gpt_tokens_per_sec" not in proc.stdout

@@ -70,15 +70,17 @@ def test_no_update_until_kth_microstep():
 
 
 def test_strategy_gradient_merge_wires_trainstep():
-    """strategy.gradient_merge=True + k_steps flows into TrainStep via
-    fleet (the dead-config-key fix: setting it changes semantics)."""
+    """strategy.gradient_merge=True + k_steps flows into TrainStep through
+    fleet.distributed_optimizer — the boundary where strategy applies
+    (reference: fleet_base.py:830 meta-optimizer chain)."""
     strategy = fleet.DistributedStrategy()
     strategy.gradient_merge = True
     strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
     fleet.init(is_collective=True, strategy=strategy)
 
     model, loss_fn, xs, ys = _model_and_data()
-    step = TrainStep(model, loss_fn, SGD(learning_rate=0.1))
+    opt = fleet.distributed_optimizer(SGD(learning_rate=0.1))
+    step = TrainStep(model, loss_fn, opt)
     assert step.grad_accum_steps == 4
     w0 = {k: np.asarray(p._data) for k, p in model.named_parameters()}
     step(Tensor(xs[:4]), Tensor(ys[:4]))
@@ -86,12 +88,45 @@ def test_strategy_gradient_merge_wires_trainstep():
         np.testing.assert_array_equal(np.asarray(v), w0[k])
 
 
-def test_localsgd_and_dgc_raise():
+def test_bare_trainstep_unaffected_by_fleet_strategy():
+    """A TrainStep over a BARE optimizer must update on step 1 even after
+    fleet.init with gradient_merge — the strategy is scoped to
+    fleet.distributed_optimizer, never a process-global rewiring (the
+    round-4 leak: a later unrelated TrainStep silently became a 4-step
+    accumulator)."""
     strategy = fleet.DistributedStrategy()
-    with pytest.raises(NotImplementedError, match="LocalSGD"):
-        strategy.localsgd = True
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    model, loss_fn, xs, ys = _model_and_data()
+    step = TrainStep(model, loss_fn, SGD(learning_rate=0.1))
+    assert step.grad_accum_steps == 1
+    w0 = {k: np.asarray(p._data) for k, p in model.named_parameters()}
+    step(Tensor(xs[:4]), Tensor(ys[:4]))
+    assert any(not np.array_equal(np.asarray(v), w0[k])
+               for k, v in step.params.items())
+
+
+def test_strategy_snapshot_frozen_at_distributed_optimizer():
+    """Mutating the strategy AFTER distributed_optimizer must not change
+    an already-wrapped optimizer (snapshot semantics)."""
+    strategy = fleet.DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(SGD(learning_rate=0.1), strategy)
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 4}
+    model, loss_fn, _, _ = _model_and_data()
+    step = TrainStep(model, loss_fn, opt)
+    assert step.grad_accum_steps == 1
+
+
+def test_dgc_raises():
+    strategy = fleet.DistributedStrategy()
     with pytest.raises(NotImplementedError, match="gradient compression"):
         strategy.dgc = True
-    # setting False stays a no-op (config parity)
+    # setting False stays a no-op (config parity); localsgd is implemented
+    strategy.localsgd = True
+    assert strategy.localsgd
     strategy.localsgd = False
     strategy.dgc = False

@@ -119,10 +119,70 @@ def test_adaptive_localsgd_adjusts_k():
     assert step.k_steps < 8, step.k_steps
 
 
-def test_strategy_flag_no_longer_hard_errors():
-    from paddle_tpu.distributed.fleet import DistributedStrategy
-    s = DistributedStrategy()
-    s.localsgd = True
-    assert s.localsgd
-    s.localsgd_configs = {"k_steps": 4}
-    assert s.localsgd_configs["k_steps"] == 4
+def test_strategy_localsgd_wires_trainstep():
+    """The full fleet path: strategy.localsgd=True → fleet.init →
+    distributed_optimizer → TrainStep builds a LocalSGDTrainStep; at k=1
+    it matches synchronous SGD exactly (no decorative config keys)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        LocalSGDTrainStep)
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.optimizer import SGD
+
+    def loss_fn(layer, x, y):
+        return F.cross_entropy(layer(x), y)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(SGD(learning_rate=0.1))
+    m1 = _model()
+    step = TrainStep(m1, loss_fn, opt)
+    assert isinstance(step, LocalSGDTrainStep)
+    assert step.k_steps == 1
+
+    m2 = _model()
+    sync = TrainStep(m2, loss_fn, SGD(learning_rate=0.1))
+    assert not isinstance(sync, LocalSGDTrainStep)
+
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        x, y = _data(rng)
+        l_local = float(step(x, y))
+        l_sync = float(sync(x, y))
+        np.testing.assert_allclose(l_local, l_sync, rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_updates_buffers():
+    """BN running stats must not freeze under LocalSGD training — buffer
+    writes thread through the shard_map carry and are replica-averaged."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        LocalSGDTrainStep)
+    from paddle_tpu.optimizer import SGD
+
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8),
+                          nn.ReLU(), nn.Linear(8, 2))
+
+    def loss_fn(layer, x, y):
+        return F.cross_entropy(layer(x), y)
+
+    step = LocalSGDTrainStep(model, loss_fn, SGD(learning_rate=0.1),
+                             _mesh(2), k_steps=2)
+    mean0 = {k: np.asarray(v) for k, v in step.buffers.items()
+             if "_mean" in k}
+    assert mean0, "model has no BN running-mean buffer?"
+    rng = np.random.default_rng(9)
+    for _ in range(2):
+        x, y = _data(rng)
+        step(x, y)
+    moved = any(not np.array_equal(np.asarray(step.buffers[k]), v)
+                for k, v in mean0.items())
+    assert moved, "BN running stats froze during LocalSGD training"

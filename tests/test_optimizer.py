@@ -188,3 +188,42 @@ def test_multi_tensor_missing_grad_raises():
     state = opt.init_state(params)
     with pytest.raises(ValueError, match="use_multi_tensor"):
         opt.apply_gradients(params, {"a": jnp.ones((4,))}, state)
+
+
+def test_adam_bf16_state_dtype_loss_parity():
+    """state_dtype="bfloat16" halves optimizer-state HBM traffic; the
+    update computes in f32, so the loss curve tracks the f32-state run
+    (reference analogue: adam_op.cu multi-precision fused variants)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    def run(state_dtype):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+        def loss_fn(layer, x, y):
+            return F.cross_entropy(layer(x), y)
+
+        step = TrainStep(m, loss_fn,
+                         AdamW(learning_rate=1e-2,
+                               state_dtype=state_dtype))
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(30):
+            x = rng.normal(size=(32, 8)).astype(np.float32)
+            y = (x.sum(1) > 0).astype(np.int64)
+            losses.append(float(step(x, y)))
+        slots = jax.tree_util.tree_leaves(step.opt_state)
+        return losses, slots
+
+    import jax
+    l32, s32 = run("float32")
+    l16, s16 = run("bfloat16")
+    assert all(s.dtype == jax.numpy.bfloat16 for s in s16
+               if s.ndim > 0)
+    assert l16[-1] < l16[0] * 0.5            # both learn
+    assert abs(l32[-1] - l16[-1]) < 0.05 + 0.1 * l32[-1], (l32[-1], l16[-1])

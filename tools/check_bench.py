@@ -1,0 +1,138 @@
+"""Benchmark regression gate.
+
+Compares the metric lines of two driver bench records (BENCH_r{N}.json)
+and fails loudly when a metric regressed beyond tolerance — the analogue
+of the reference's op-benchmark CI gate
+(/root/reference/tools/check_op_benchmark_result.py:1, which diffs op
+timings against the develop branch and fails the PR over threshold).
+
+Usage:
+    python tools/check_bench.py BENCH_r04.json BENCH_r05.json
+    python tools/check_bench.py --tolerance 0.15 old.json new.json
+
+Metric direction is derived from the unit: time-like units (ms, s, us)
+regress when they grow; rate-like units (tokens/s, img/s, steps/s)
+regress when they shrink. The default tolerance (10%) absorbs normal
+tunnel noise; bench.py's min-of-k timing keeps the noise floor below it.
+
+Exit code: 0 = no regression, 1 = regression(s), 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_TOLERANCE = 0.10
+_TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds"}
+
+
+def _metric_list(record) -> List[dict]:
+    """A BENCH record's parsed field is one metric dict (old rounds) or a
+    list (round 5+); raw metric-line lists are accepted directly. Falls
+    back to scraping JSON lines out of the stored stdout tail."""
+    if isinstance(record, list):
+        return [m for m in record if isinstance(m, dict) and "metric" in m]
+    if isinstance(record, dict):
+        if "metric" in record:
+            return [record]
+        parsed = record.get("parsed")
+        if parsed is not None:
+            return _metric_list(parsed)
+        tail = record.get("tail", "")
+        out = []
+        for line in tail.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict) and "metric" in d:
+                    out.append(d)
+        return out
+    return []
+
+
+def lower_is_better(unit: str) -> bool:
+    return unit.strip().lower() in _TIME_UNITS
+
+
+def compare(old: List[dict], new: List[dict],
+            tolerance: float = DEFAULT_TOLERANCE) -> List[str]:
+    """Returns a list of human-readable regression messages (empty = ok)."""
+    prev: Dict[str, dict] = {m["metric"]: m for m in old}
+    problems: List[str] = []
+    for m in new:
+        name = m["metric"]
+        ref = prev.get(name)
+        if ref is None:
+            continue                      # new metric: nothing to gate
+        try:
+            v_new, v_old = float(m["value"]), float(ref["value"])
+        except (KeyError, TypeError, ValueError):
+            problems.append(f"{name}: malformed value "
+                            f"({m.get('value')!r} vs {ref.get('value')!r})")
+            continue
+        if v_old == 0:
+            continue
+        unit = str(m.get("unit", ref.get("unit", "")))
+        if lower_is_better(unit):
+            ratio = v_new / v_old         # >1 means slower
+            if ratio > 1 + tolerance:
+                problems.append(
+                    f"{name}: {v_old:g} -> {v_new:g} {unit} "
+                    f"(+{(ratio - 1) * 100:.1f}%, tolerance "
+                    f"{tolerance * 100:.0f}%)")
+        else:
+            ratio = v_new / v_old         # <1 means less throughput
+            if ratio < 1 - tolerance:
+                problems.append(
+                    f"{name}: {v_old:g} -> {v_new:g} {unit} "
+                    f"(-{(1 - ratio) * 100:.1f}%, tolerance "
+                    f"{tolerance * 100:.0f}%)")
+    missing = set(prev) - {m["metric"] for m in new}
+    for name in sorted(missing):
+        problems.append(f"{name}: metric disappeared from the new record")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    tol = DEFAULT_TOLERANCE
+    if "--tolerance" in argv:
+        i = argv.index("--tolerance")
+        try:
+            tol = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--tolerance needs a float", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        with open(argv[0]) as f:
+            old = _metric_list(json.load(f))
+        with open(argv[1]) as f:
+            new = _metric_list(json.load(f))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read records: {e}", file=sys.stderr)
+        return 2
+    if not old:
+        print(f"{argv[0]}: no metric lines found (nothing to gate)")
+        return 0
+    problems = compare(old, new, tol)
+    if problems:
+        print("BENCH REGRESSION:")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"bench gate ok: {len(new)} metric(s), none regressed beyond "
+          f"{tol * 100:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

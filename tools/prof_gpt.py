@@ -36,7 +36,7 @@ def timed(fn, args, iters=8):
 
 
 def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
-          grads_only=False, mt=False):
+          grads_only=False, mt=False, state_dtype="float32"):
     """remat: None | 'full' | 'dots' (selective: save dot outputs)."""
     import jax
     import paddle_tpu as paddle
@@ -96,7 +96,8 @@ def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
         return (lambda i, la: g(params, i, la)), (ids, labels)
 
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                weight_decay=0.01, use_multi_tensor=mt)
+                weight_decay=0.01, use_multi_tensor=mt,
+                state_dtype=state_dtype)
     step = TrainStep(model, loss_fn, opt)
     return step, (ids, labels)
 
@@ -104,6 +105,8 @@ def build(B=8, S=1024, drop=0.1, remat=None, fwd_only=False,
 MODES = {
     "base": dict(),
     "base_mt": dict(mt=True),
+    "mt_bf16st": dict(mt=True, state_dtype="bfloat16"),
+    "bf16st": dict(state_dtype="bfloat16"),
     "b12_mt": dict(B=12, mt=True),
     "fwdonly": dict(fwd_only=True),
     "gradsonly": dict(grads_only=True),
