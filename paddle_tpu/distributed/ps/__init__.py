@@ -33,13 +33,15 @@ import numpy as np
 from ...core.tensor import TapeNode, Tensor, _wrap_outputs, is_grad_enabled
 from ...nn.layer import Layer
 
-__all__ = ["SparseTable", "DistributedEmbedding", "PSClient",
-           "PSServerHandle", "AsyncCommunicator", "GeoCommunicator",
-           "run_server", "role_from_env", "server_endpoints_from_env"]
+__all__ = ["SparseTable", "SSDSparseTable", "DistributedEmbedding",
+           "PSClient", "PSServerHandle", "AsyncCommunicator",
+           "GeoCommunicator", "run_server", "role_from_env",
+           "server_endpoints_from_env"]
 
 from .service import (AsyncCommunicator, GeoCommunicator,  # noqa: E402
                       PSClient, PSServerHandle, role_from_env, run_server,
                       server_endpoints_from_env)
+from .ssd_table import SSDSparseTable  # noqa: E402
 
 
 class SparseTable:
