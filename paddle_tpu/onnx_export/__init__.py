@@ -48,14 +48,27 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
             f"unsupported ONNX export options: {sorted(configs)}")
     specs = [s if isinstance(s, InputSpec) else InputSpec(s)
              for s in input_spec]
-    for s_ in specs:
-        if any(d is None or d < 0 for d in s_.shape):
-            raise ValueError(
-                f"input_spec shape {tuple(s_.shape)} has dynamic dims: "
-                "the exporter bakes static shapes into Reshape/Expand "
-                "initializers, so a None dim would silently produce a "
-                "batch-1-only model — give concrete shapes")
-    example = [jnp.zeros(tuple(s.shape), s.dtype) for s in specs]
+    # None/-1 dims become jax.export symbolic dimensions in ONE shared
+    # scope (all inputs' batch axes must co-vary) and export as
+    # ``dim_param`` symbols; shape operands touching them lower to
+    # runtime Shape/Gather/Concat subgraphs (convert.py shape_tensor)
+    dynamic = any(d is None or d < 0 for s_ in specs for d in s_.shape)
+    if dynamic:
+        from jax import export as jexport
+        scope = jexport.SymbolicScope()
+
+        def dim(i, ax, d):
+            if d is not None and d >= 0:
+                return str(int(d))
+            return "batch" if ax == 0 else f"dyn_{i}_{ax}"
+
+        shapes = [jexport.symbolic_shape(
+            ", ".join(dim(i, ax, d) for ax, d in enumerate(s_.shape)),
+            scope=scope) for i, s_ in enumerate(specs)]
+        example = [jax.ShapeDtypeStruct(shp, s_.dtype)
+                   for shp, s_ in zip(shapes, specs)]
+    else:
+        example = [jnp.zeros(tuple(s.shape), s.dtype) for s in specs]
 
     if isinstance(layer, Layer):
         was_training = layer.training

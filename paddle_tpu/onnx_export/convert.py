@@ -36,6 +36,11 @@ def _onnx_dtype(dtype) -> int:
             f"dtype {dtype} has no ONNX mapping") from None
 
 
+def _is_sym(d) -> bool:
+    """A symbolic dimension (jax.export dim polynomial) vs a plain int."""
+    return not isinstance(d, (int, np.integer))
+
+
 class _Builder:
     def __init__(self):
         self.nodes: List[bytes] = []
@@ -43,6 +48,63 @@ class _Builder:
         self.counter = 0
         self.names: Dict[int, str] = {}   # id(jax var) -> onnx name
         self._literal_cache: Dict = {}
+        # dynamic-batch support: symbol name -> (graph input name, axis)
+        # so any shape operand containing the symbol resolves at RUNTIME
+        # from Shape(input) — the dim_param contract
+        self.sym_sources: Dict[str, tuple] = {}
+        self._dim_cache: Dict[str, str] = {}
+
+    def register_input_dims(self, name, shape):
+        for ax, d in enumerate(shape):
+            if _is_sym(d):
+                self.sym_sources.setdefault(str(d), (name, ax))
+
+    def dim_value(self, d) -> str:
+        """int64[1] tensor holding a symbolic dim's runtime value."""
+        key = str(d)
+        if key in self._dim_cache:
+            return self._dim_cache[key]
+        src = self.sym_sources.get(key)
+        if src is None:
+            raise UnsupportedOnnxExport(
+                f"symbolic dimension {d} does not appear in any graph "
+                "input shape; dynamic dims must be tied to an input")
+        in_name, ax = src
+        shp = self.emit("Shape", [in_name])
+        out = self.emit("Gather",
+                        [shp, self.add_const(np.asarray([ax], np.int64))],
+                        attributes=[proto.attr_int("axis", 0)])
+        self._dim_cache[key] = out
+        return out
+
+    def shape_tensor(self, dims) -> str:
+        """Name of an int64 1-D tensor holding `dims`: an initializer when
+        fully static, a Concat of constants + runtime dim reads when any
+        entry is symbolic."""
+        dims = list(dims)
+        if all(not _is_sym(d) for d in dims):
+            return self.add_const(np.asarray([int(d) for d in dims],
+                                             np.int64))
+        parts: List[str] = []
+        pending: List[int] = []
+
+        def flush():
+            if pending:
+                parts.append(self.add_const(
+                    np.asarray(pending, np.int64)))
+                pending.clear()
+
+        for d in dims:
+            if _is_sym(d):
+                flush()
+                parts.append(self.dim_value(d))
+            else:
+                pending.append(int(d))
+        flush()
+        if len(parts) == 1:
+            return parts[0]
+        return self.emit("Concat", parts,
+                         attributes=[proto.attr_int("axis", 0)])
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -120,24 +182,26 @@ def _handle_dot_general(b: _Builder, eqn, invals):
     r_free = [d for d in range(r_nd) if d not in rc and d not in rb]
     l_shape = lhs.aval.shape
     r_shape = rhs.aval.shape
-    batch = [l_shape[d] for d in lb]
+    batch = [l_shape[d] for d in lb]       # may hold symbolic dims
+    if any(_is_sym(l_shape[d]) for d in l_free + list(lc)) or \
+            any(_is_sym(r_shape[d]) for d in r_free):
+        raise UnsupportedOnnxExport(
+            "dot_general with a symbolic free/contracting dim cannot "
+            "flatten to MatMul (only batch dims may be dynamic)")
     M = int(np.prod([l_shape[d] for d in l_free])) if l_free else 1
     K = int(np.prod([l_shape[d] for d in lc]))
     N = int(np.prod([r_shape[d] for d in r_free])) if r_free else 1
 
     lp = b.emit("Transpose", [lname], attributes=[
         proto.attr_ints("perm", list(lb) + l_free + list(lc))])
-    lp = b.emit("Reshape", [lp, b.add_const(
-        np.asarray(batch + [M, K], np.int64))])
+    lp = b.emit("Reshape", [lp, b.shape_tensor(batch + [M, K])])
     rp = b.emit("Transpose", [rname], attributes=[
         proto.attr_ints("perm", list(rb) + list(rc) + r_free)])
-    rp = b.emit("Reshape", [rp, b.add_const(
-        np.asarray(batch + [K, N], np.int64))])
+    rp = b.emit("Reshape", [rp, b.shape_tensor(batch + [K, N])])
     mm = b.emit("MatMul", [lp, rp])
     out_shape = batch + [l_shape[d] for d in l_free] \
         + [r_shape[d] for d in r_free]
-    return b.emit("Reshape", [mm, b.add_const(
-        np.asarray(out_shape, np.int64))])
+    return b.emit("Reshape", [mm, b.shape_tensor(out_shape)])
 
 
 def _handle_conv(b: _Builder, eqn, invals):
@@ -175,13 +239,32 @@ def _handle_gather(b: _Builder, eqn, invals):
         # indices arrive as [..., 1]; drop the trailing index-vector dim
         idx = invals[1]
         if idx_aval.shape and idx_aval.shape[-1] == 1:
-            idx = b.emit("Reshape", [idx, b.add_const(
-                np.asarray(idx_aval.shape[:-1], np.int64))])
+            idx = b.emit("Reshape", [idx, b.shape_tensor(
+                idx_aval.shape[:-1])])
         return b.emit("Gather", [invals[0], idx],
                       attributes=[proto.attr_int("axis", 0)])
+    # single-position pick along one axis (e.g. the CLS select h[:, 0],
+    # which lowers to this form under symbolic batch dims): indices are a
+    # length-1 coordinate vector, every other axis is a full slice
+    if len(dn.start_index_map) == 1:
+        ax = dn.start_index_map[0]
+        idx_aval = eqn.invars[1].aval
+        full_others = all(
+            (i == ax and s == 1) or
+            (i != ax and (s == operand.shape[i]))
+            for i, s in enumerate(ss))
+        if dn.collapsed_slice_dims == (ax,) and full_others and \
+                tuple(idx_aval.shape) == (1,) and \
+                tuple(dn.offset_dims) == tuple(
+                    range(len(operand.shape) - 1)):
+            scalar = b.emit("Reshape", [
+                invals[1], b.add_const(np.asarray([], np.int64))])
+            return b.emit("Gather", [invals[0], scalar],
+                          attributes=[proto.attr_int("axis", ax)])
     raise UnsupportedOnnxExport(
         f"gather with dimension_numbers {dn} / slice_sizes {ss} has no "
-        "ONNX mapping (only axis-0 embedding-style gathers export)")
+        "ONNX mapping (only axis-0 embedding-style gathers and "
+        "single-position axis picks export)")
 
 
 def _inner_closed(eqn):
@@ -228,6 +311,25 @@ def _convert_eqns(b: _Builder, eqns):
             limits = eqn.params["limit_indices"]
             strides = eqn.params["strides"] or [1] * len(starts)
             axes = list(range(len(starts)))
+            if any(_is_sym(s) for s in starts):
+                raise UnsupportedOnnxExport(
+                    "slice with symbolic start indices")
+            in_shape = eqn.invars[0].aval.shape
+            # a symbolic limit exports only as "to the end of that dim"
+            # (ONNX Slice clamps INT64_MAX); a partial symbolic limit
+            # (e.g. first half of a 2*batch axis) must raise, not
+            # silently export full-length
+            fixed = []
+            for ax2, e in enumerate(limits):
+                if not _is_sym(e):
+                    fixed.append(int(e))
+                elif e == in_shape[ax2]:
+                    fixed.append(1 << 62)
+                else:
+                    raise UnsupportedOnnxExport(
+                        f"slice with symbolic limit {e} != dim "
+                        f"{in_shape[ax2]} has no ONNX mapping")
+            limits = fixed
             out = b.emit("Slice", [
                 invals[0],
                 b.add_const(np.asarray(starts, np.int64)),
@@ -237,15 +339,37 @@ def _convert_eqns(b: _Builder, eqns):
         elif prim == "gather":
             out = _handle_gather(b, eqn, invals)
         elif prim == "iota":
-            # static shape: bake the index grid as an initializer
             shape = eqn.outvars[0].aval.shape
             d = eqn.params["dimension"]
-            view = [1] * len(shape)
-            view[d] = shape[d]
-            grid = np.broadcast_to(
-                np.arange(shape[d]).reshape(view), shape)
-            out = b.add_const(np.ascontiguousarray(grid).astype(
-                np.dtype(eqn.outvars[0].aval.dtype)))
+            np_dt = np.dtype(eqn.outvars[0].aval.dtype)
+            if all(not _is_sym(s) for s in shape):
+                # static shape: bake the index grid as an initializer
+                view = [1] * len(shape)
+                view[d] = shape[d]
+                grid = np.broadcast_to(
+                    np.arange(shape[d]).reshape(view), shape)
+                out = b.add_const(
+                    np.ascontiguousarray(grid).astype(np_dt))
+            else:
+                # dynamic dims: Range along the iota axis (runtime length
+                # when symbolic), reshaped to the 1-padded view and
+                # Expanded to the runtime shape
+                if _is_sym(shape[d]):
+                    n = b.emit("Reshape", [b.dim_value(shape[d]),
+                                           b.add_const(
+                                               np.asarray([], np.int64))])
+                    rng = b.emit("Range", [
+                        b.add_const(np.asarray(0, np.int64)), n,
+                        b.add_const(np.asarray(1, np.int64))])
+                else:
+                    rng = b.add_const(np.arange(shape[d], dtype=np.int64))
+                view = [1] * len(shape)
+                view[d] = shape[d]
+                mid = b.emit("Reshape", [rng, b.shape_tensor(view)])
+                out = b.emit("Expand", [mid, b.shape_tensor(shape)])
+                if np_dt != np.int64:
+                    out = b.emit("Cast", [out], attributes=[
+                        proto.attr_int("to", _onnx_dtype(np_dt))])
         elif prim == "rsqrt":
             s = b.emit("Sqrt", invals)
             one = b.add_const(np.asarray(
@@ -261,9 +385,8 @@ def _convert_eqns(b: _Builder, eqns):
         elif prim == "conv_general_dilated":
             out = _handle_conv(b, eqn, invals)
         elif prim in ("reshape", "squeeze", "expand_dims"):
-            shape = b.add_const(np.asarray(eqn.outvars[0].aval.shape,
-                                           np.int64))
-            out = b.emit("Reshape", [invals[0], shape])
+            out = b.emit("Reshape", [
+                invals[0], b.shape_tensor(eqn.outvars[0].aval.shape)])
         elif prim == "transpose":
             out = b.emit("Transpose", invals, attributes=[
                 proto.attr_ints("perm", eqn.params["permutation"])])
@@ -276,9 +399,8 @@ def _convert_eqns(b: _Builder, eqns):
                 inter[d] = in_shape[i]
             if tuple(eqn.invars[0].aval.shape) == ():
                 inter = [1] * max(len(tgt), 1)
-            rs = b.add_const(np.asarray(inter, np.int64))
-            mid = b.emit("Reshape", [invals[0], rs])
-            shp = b.add_const(np.asarray(tgt if tgt else (1,), np.int64))
+            mid = b.emit("Reshape", [invals[0], b.shape_tensor(inter)])
+            shp = b.shape_tensor(tgt if tgt else (1,))
             out = b.emit("Expand", [mid, shp])
             if not tgt:
                 out = b.emit("Reshape", [out, b.add_const(
@@ -368,11 +490,16 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, consts, graph_name="model",
     jaxpr = closed_jaxpr.jaxpr
     b = _Builder()
 
+    def vi_shape(shape):
+        return [str(d) if _is_sym(d) else int(d) for d in shape]
+
     graph_inputs = []
     for var, name in zip(jaxpr.invars[:len(input_names)], input_names):
         b.names[id(var)] = name
+        b.register_input_dims(name, var.aval.shape)
         dt = _onnx_dtype(var.aval.dtype)
-        graph_inputs.append(proto.value_info(name, dt, var.aval.shape))
+        graph_inputs.append(proto.value_info(name, dt,
+                                             vi_shape(var.aval.shape)))
     for var, arr in zip(jaxpr.invars[len(input_names):], consts):
         b.names[id(var)] = b.add_const(np.asarray(arr), hint="w")
     for var, arr in zip(jaxpr.constvars, closed_jaxpr.consts):
@@ -384,7 +511,8 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, consts, graph_name="model",
     for var in jaxpr.outvars:
         nm = b.name_of(var)
         dt = _onnx_dtype(var.aval.dtype)
-        graph_outputs.append(proto.value_info(nm, dt, var.aval.shape))
+        graph_outputs.append(proto.value_info(nm, dt,
+                                              vi_shape(var.aval.shape)))
 
     graph = proto.graph_proto(b.nodes, graph_name, b.initializers,
                               graph_inputs, graph_outputs)

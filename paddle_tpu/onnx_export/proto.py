@@ -140,7 +140,15 @@ def node_proto(op_type: str, inputs, outputs, name: str = "",
 
 
 def value_info(name: str, elem_type: int, shape) -> bytes:
-    dims = b"".join(field_bytes(1, field_varint(1, d)) for d in shape)
+    """String dims encode as ``dim_param`` (symbolic, e.g. a dynamic
+    batch axis — onnx.proto TensorShapeProto.Dimension field 2);
+    integers as ``dim_value``."""
+    dims = b""
+    for d in shape:
+        if isinstance(d, str):
+            dims += field_bytes(1, field_string(2, d))
+        else:
+            dims += field_bytes(1, field_varint(1, int(d)))
     shape_proto = dims
     tensor_type = field_varint(1, elem_type) + field_bytes(2, shape_proto)
     type_proto = field_bytes(1, tensor_type)

@@ -178,6 +178,13 @@ def run_model(model: OnnxModel, feeds: Dict[str, np.ndarray]) -> List:
             out = np.where(i[0].astype(bool), i[1], i[2])
         elif op == "Identity":
             out = i[0]
+        elif op == "Shape":
+            out = np.asarray(i[0].shape, np.int64)
+        elif op == "Range":
+            out = np.arange(int(np.asarray(i[0])),
+                            int(np.asarray(i[1])),
+                            int(np.asarray(i[2])),
+                            dtype=np.asarray(i[0]).dtype)
         elif op == "Slice":
             starts, ends, axes, steps = (list(map(int, v)) for v in i[1:5])
             sl = [slice(None)] * i[0].ndim

@@ -29,7 +29,16 @@ def _ints(v):
         return tuple(int(i) for i in np.asarray(v.data).reshape(-1))
     if isinstance(v, (int, np.integer)):
         return (int(v),)
-    return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
+
+    def one(i):
+        if isinstance(i, Tensor):
+            return int(i.item())
+        if isinstance(i, (int, np.integer)):
+            return int(i)
+        # symbolic dims (jax.export shape polynomials, used by the ONNX
+        # dynamic-batch exporter) pass through uncoerced
+        return i
+    return tuple(one(i) for i in v)
 
 
 def cast(x, dtype):

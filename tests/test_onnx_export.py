@@ -110,7 +110,7 @@ def test_wire_format_roundtrip_details(tmp_path):
 
 def test_opset13_forms_and_validation(tmp_path):
     """Review regressions: ReduceMax carries axes as an ATTRIBUTE at
-    opset 13; dynamic dims, low opsets and unknown configs are rejected."""
+    opset 13; low opsets and unknown configs are rejected."""
 
     class RMax(paddle.nn.Layer):
         def forward(self, x):
@@ -125,9 +125,6 @@ def test_opset13_forms_and_validation(tmp_path):
     (out,) = onnx_export.run_model(model, {"x0": x})
     np.testing.assert_allclose(out, x.max(-1), atol=1e-6)
 
-    with pytest.raises(ValueError, match="dynamic dims"):
-        onnx_export.export(MLP(), str(tmp_path / "dyn"),
-                           input_spec=[InputSpec((None, 8), "float32")])
     with pytest.raises(ValueError, match="opset"):
         onnx_export.export(MLP(), str(tmp_path / "old"),
                            input_spec=[InputSpec((1, 8), "float32")],
@@ -217,3 +214,57 @@ def test_resnet18_export_parity(tmp_path):
     with no_grad():
         ref = m(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+
+def test_dynamic_batch_dim_param_bert(tmp_path):
+    """A None batch dim exports as a symbolic ``dim_param``; the bundled
+    runtime executes TWO batch sizes from ONE file to numeric parity
+    (round-4 verdict task: dynamic batch via dim_param)."""
+    from paddle_tpu.models.bert import BertForMaskedLM, bert_tiny
+    from paddle_tpu.onnx_export import proto
+
+    paddle.seed(0)
+    m = BertForMaskedLM(bert_tiny())
+    m.eval()
+    p = onnx_export.export(m, str(tmp_path / "bert_dyn"),
+                           input_spec=[InputSpec((None, 128), "int32")])
+    model = onnx_export.load_model(p)
+    # the input's leading dim is a dim_param named "batch"
+    with open(p, "rb") as f:
+        mfields = proto.parse_message(f.read())
+    g = proto.parse_message(mfields[7][0])
+    vi = proto.parse_message(g[11][0])
+    tensor_type = proto.parse_message(
+        proto.parse_message(vi[2][0])[1][0])
+    shape_msg = proto.parse_message(tensor_type[2][0])
+    dim0 = proto.parse_message(shape_msg[1][0])
+    assert dim0[2][0].decode() == "batch", dim0
+    # runtime executes two batch sizes from the same file
+    rng = np.random.default_rng(1)
+    for B in (2, 5):
+        ids = rng.integers(0, 256, (B, 128)).astype(np.int32)
+        (out,) = onnx_export.run_model(model, {"x0": ids})
+        with no_grad():
+            ref = m(paddle.to_tensor(ids)).numpy()
+        assert out.shape[0] == B
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_dynamic_batch_mlp_and_gather_paths(tmp_path):
+    """Dynamic batch through the simple-MatMul path + embedding Gather +
+    broadcast/iota lowering."""
+    from paddle_tpu import nn
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    net.eval()
+    p = onnx_export.export(net, str(tmp_path / "mlp_dyn"),
+                           input_spec=[InputSpec((None, 16), "float32")])
+    model = onnx_export.load_model(p)
+    rng = np.random.default_rng(2)
+    for B in (1, 7):
+        x = rng.normal(size=(B, 16)).astype(np.float32)
+        (out,) = onnx_export.run_model(model, {"x0": x})
+        with no_grad():
+            ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
