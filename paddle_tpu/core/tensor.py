@@ -206,6 +206,15 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        """Iterate over the leading axis (static length, so loops unroll
+        under trace). Without this, python's sequence-protocol fallback
+        never terminates: jnp clamps out-of-range integer indices instead
+        of raising IndexError."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self._data.shape[0]))
+
     def __repr__(self):
         grad_part = "" if self.stop_gradient else ", stop_gradient=False"
         return (

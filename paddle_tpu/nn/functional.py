@@ -677,27 +677,37 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     use_batch_stats = training and not use_global_stats
 
-    def _bn_eval(a, rm, rv, *wb):
-        shape = [1] * a.ndim
-        shape[ch_axis] = a.shape[ch_axis]
-        out = (a - rm.reshape(shape).astype(a.dtype)) * \
-            jax.lax.rsqrt(rv.reshape(shape).astype(jnp.float32) + epsilon).astype(a.dtype)
+    # dtype-preserving normalization: statistics and the folded
+    # scale/shift compute in f32, the per-element application runs in the
+    # INPUT dtype (one multiply + one add, fusable into the producing
+    # conv's epilogue). Under the AMP O1 bf16 activation stream this
+    # keeps conv->bn->relu chains entirely bf16 — the old blacklisted
+    # form round-tripped every conv output through f32, which the
+    # ResNet-50 trace showed as ~40 ms/step of pure convert/copy traffic.
+    def _bn_apply(a, mean, var, wb):
+        inv = jax.lax.rsqrt(var + epsilon)
         if wb:
             w, b = wb
-            out = out * w.reshape(shape) + b.reshape(shape)
-        return out
+            scale = w.astype(jnp.float32) * inv
+            shift = b.astype(jnp.float32) - mean * scale
+        else:
+            scale = inv
+            shift = -mean * inv
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        return a * scale.reshape(shape).astype(a.dtype) \
+            + shift.reshape(shape).astype(a.dtype)
+
+    def _bn_eval(a, rm, rv, *wb):
+        return _bn_apply(a, rm.astype(jnp.float32),
+                         rv.astype(jnp.float32), wb)
 
     if use_batch_stats:
         def _bn_train(a, rm, rv, *wb):
-            mean = jnp.mean(a.astype(jnp.float32), axis=reduce_axes)
-            var = jnp.var(a.astype(jnp.float32), axis=reduce_axes)
-            shape = [1] * a.ndim
-            shape[ch_axis] = a.shape[ch_axis]
-            out = (a - mean.reshape(shape).astype(a.dtype)) * \
-                jax.lax.rsqrt(var.reshape(shape) + epsilon).astype(a.dtype)
-            if wb:
-                w, b = wb
-                out = out * w.reshape(shape) + b.reshape(shape)
+            a32 = a.astype(jnp.float32)
+            mean = jnp.mean(a32, axis=reduce_axes)
+            var = jnp.var(a32, axis=reduce_axes)
+            out = _bn_apply(a, mean, var, wb)
             new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
             new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
             return out, new_rm, new_rv

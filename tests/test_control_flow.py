@@ -572,3 +572,80 @@ def test_ast_break_and_continue_in_for_range():
     x = paddle.to_tensor(np.ones((2,), np.float32))
     np.testing.assert_allclose(g(x).numpy(), 12.0)   # 0+2+4+6
     np.testing.assert_allclose(g(x).numpy(), f(x).numpy())
+
+
+def test_ast_for_over_tensor_rows():
+    """`for x in tensor:` iterates the leading axis — eager AND compiled
+    (static length, unrolled under trace). Reference:
+    dygraph_to_static/loop_transformer.py:45 converts tensor iterables;
+    here Tensor.__iter__ + static shapes make the python loop itself
+    trace-safe."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(t):
+        s = t[0] * 0.0
+        for row in t:
+            s = s + row * 2.0
+        return s
+
+    g = convert_to_static(f)
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    want = np.asarray(t.numpy()).sum(0) * 2.0
+    np.testing.assert_allclose(g(t).numpy(), want)
+
+    def pure(a):
+        return g(Tensor(a))._data
+
+    out = jax.jit(pure)(t._data)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_ast_append_then_stack_decode_loop():
+    """Append-then-stack: outputs collected in a python list across a
+    for-range loop, stacked after — compiles via @to_static and matches
+    eager (the reference's tensor-array pattern)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        ys = []
+        h = x
+        for i in range(4):
+            h = h * 0.5 + float(i)
+            ys.append(h)
+        return paddle.stack(ys, axis=0)
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    ref = f(x).numpy()
+    np.testing.assert_allclose(g(x).numpy(), ref)
+
+    def pure(a):
+        return g(Tensor(a))._data
+
+    out = jax.jit(pure)(x._data)
+    np.testing.assert_allclose(np.asarray(out), ref)
+
+
+def test_ast_for_over_list_of_tensors():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(parts):
+        s = parts[0] * 0.0
+        for p in parts:
+            s = s + p
+        return s
+
+    g = convert_to_static(f)
+    parts = [paddle.to_tensor(np.full((2,), float(i), np.float32))
+             for i in range(3)]
+    np.testing.assert_allclose(g(parts).numpy(), [3.0, 3.0])

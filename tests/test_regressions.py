@@ -220,3 +220,30 @@ def test_amp_eager_backward_across_listed_boundaries():
     assert str(z.grad.dtype) == "bfloat16"
     np.testing.assert_allclose(np.asarray(z.grad._data, np.float32),
                                np.e, rtol=2e-2)
+
+
+def test_bn_ema_buffers_stay_f32_under_amp():
+    """batch_norm is dtype-preserving under AMP: the f32 running-stat
+    buffers must never round through bf16 — at O1 (no cast) NOR at O2
+    (where a blanket cast would hit every float input). Round-5 review
+    finding; round-3 invariant."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    for level in ("O1", "O2"):
+        paddle.seed(0)
+        bn = nn.BatchNorm2D(8)
+        bn.train()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(4, 8, 5, 5))
+            .astype(np.float32))
+        with paddle.amp.auto_cast(level=level):
+            y = bn(x.astype("bfloat16") if level == "O1" else x)
+        assert bn._mean._data.dtype == jnp.float32, (level,
+                                                     bn._mean._data.dtype)
+        assert bn._variance._data.dtype == jnp.float32, level
+        # and the op preserves its input dtype (bf16 stream stays bf16)
+        if level == "O1":
+            assert y._data.dtype == jnp.bfloat16, y._data.dtype

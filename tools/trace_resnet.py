@@ -1,0 +1,91 @@
+"""Dev tool: trace the ResNet-50 train step; print top XLA ops."""
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import collections
+import re
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as paddle
+    paddle.set_flags({"tpu_matmul_precision": "default"})
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.vision.models import resnet50
+
+    B = int(os.environ.get("RN_B", "256"))
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+
+    def loss_fn(layer, xb, yb):
+        with paddle.amp.auto_cast(level="O1"):
+            return F.cross_entropy(layer(xb), yb)
+
+    opt = Momentum(learning_rate=0.1, parameters=model.parameters(),
+                   momentum=0.9, weight_decay=1e-4)
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.normal(size=(B, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, (B,)).astype(np.int32))
+
+    float(step(x, y))
+    for _ in range(2):
+        out = step(x, y)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = step(x, y)
+    float(out)
+    log(f"resnet50 B={B}: {(time.perf_counter()-t0)/5*1e3:.1f} ms/step")
+
+    tdir = "/tmp/rn_trace"
+    os.system(f"rm -rf {tdir}")
+    with jax.profiler.trace(tdir):
+        for _ in range(3):
+            out = step(x, y)
+        float(out)
+    paths = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        log("no trace captured")
+        return
+    with gzip.open(paths[0], "rt") as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    op_pids = {p for p, n in pid_names.items() if "TPU" in n or "XLA" in n}
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in op_pids:
+            name = e.get("name", "")
+            if name.startswith("jit_") or name.isdigit():
+                continue
+            base = re.sub(r"[.\d_]+$", "", name) or name
+            tot[base] += e.get("dur", 0)
+            cnt[base] += 1
+    total_us = sum(tot.values())
+    log(f"total device op time: {total_us/3/1e3:.1f} ms/step over 3 steps")
+    for name, us in tot.most_common(20):
+        log(f"{us/3/1e3:8.2f} ms/step ({us/total_us*100:4.1f}%)  "
+            f"x{cnt[name]:4d}  {name[:90]}")
+
+
+if __name__ == "__main__":
+    main()
