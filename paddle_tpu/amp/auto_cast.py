@@ -102,11 +102,14 @@ def _cast_target(op_name: str, st):
     from this, so they can never desynchronize."""
     if st is None or not st.enabled:
         return None
-    if op_name in _keep_dtype:
+    if op_name in _keep_dtype and op_name not in st.custom_black \
+            and op_name not in st.custom_white:
         # dtype-preserving ops: casting would hit EVERY float input —
         # including batch_norm's f32 running-stat buffers, whose EMA
         # write-back must never round through bf16. The op handles its
         # own internal precision (f32 stats, input-dtype application).
+        # An EXPLICIT custom listing overrides the default (the user's
+        # debugging knob keeps working).
         return None
     if st.level == "O2":
         return jnp.float32 if op_name in st.bl else st.dtype

@@ -28,14 +28,38 @@ def _to_saveable(obj):
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = 4, **configs):
+def save(obj: Any, path: str, protocol: int = 4,
+         encryption_key: bytes = None, **configs):
+    """``encryption_key`` writes an encrypted blob (reference:
+    fluid/framework/io/crypto/cipher.h model crypto; here the
+    authenticated scheme in framework.crypto)."""
     dirname = os.path.dirname(path)
     if dirname:
         os.makedirs(dirname, exist_ok=True)
+    if encryption_key is not None:
+        from .crypto import encrypt_bytes
+        payload = encrypt_bytes(
+            pickle.dumps(_to_saveable(obj), protocol=protocol),
+            encryption_key)
+        with open(path, "wb") as f:
+            f.write(payload)
+        return
+    # unencrypted: stream straight to disk (no full-blob materialization)
     with open(path, "wb") as f:
         pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
-def load(path: str, **configs) -> Any:
+def load(path: str, encryption_key: bytes = None, **configs) -> Any:
+    from .crypto import _MAGIC, decrypt_bytes
     with open(path, "rb") as f:
-        return pickle.load(f)
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            # plain pickle: stream (a needless key is simply unused)
+            f.seek(0)
+            return pickle.load(f)
+        if encryption_key is None:
+            raise ValueError(
+                f"{path!r} is an encrypted model file — pass "
+                "encryption_key= to paddle.load")
+        payload = head + f.read()
+    return pickle.loads(decrypt_bytes(payload, encryption_key))

@@ -1,0 +1,60 @@
+"""Model-file encryption (reference:
+paddle/fluid/framework/io/crypto/cipher.h:24 AES model crypto; here an
+authenticated PRF-CTR scheme, framework/crypto.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import crypto
+
+
+def test_bytes_roundtrip_and_tamper_detection():
+    key = crypto.generate_key()
+    msg = b"sparse rows " * 1000 + b"tail"
+    blob = crypto.encrypt_bytes(msg, key)
+    assert blob != msg and crypto.is_encrypted(blob)
+    assert crypto.decrypt_bytes(blob, key) == msg
+    # wrong key
+    with pytest.raises(crypto.DecryptionError, match="authentication"):
+        crypto.decrypt_bytes(blob, crypto.generate_key())
+    # bit flip in ciphertext
+    bad = bytearray(blob)
+    bad[len(blob) // 2] ^= 1
+    with pytest.raises(crypto.DecryptionError, match="authentication"):
+        crypto.decrypt_bytes(bytes(bad), key)
+    # distinct nonces: same plaintext encrypts differently
+    assert crypto.encrypt_bytes(msg, key) != blob
+
+
+def test_cipher_factory_file_roundtrip(tmp_path):
+    cipher = crypto.CipherFactory.create_cipher()
+    key = crypto.generate_key(16)
+    p = str(tmp_path / "enc.bin")
+    cipher.encrypt_to_file(b"model bytes", key, p)
+    assert cipher.decrypt_from_file(key, p) == b"model bytes"
+
+
+def test_paddle_save_load_encrypted(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 3)
+    key = crypto.generate_key()
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), p, encryption_key=key)
+
+    # loading without the key fails loudly, not with a pickle error
+    with pytest.raises(ValueError, match="encrypted"):
+        paddle.load(p)
+
+    state = paddle.load(p, encryption_key=key)
+    net2 = paddle.nn.Linear(4, 3)
+    net2.set_state_dict(state)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_plain_save_still_loads(tmp_path):
+    p = str(tmp_path / "plain.pdparams")
+    paddle.save({"a": paddle.to_tensor(np.ones(3, np.float32))}, p)
+    out = paddle.load(p)
+    np.testing.assert_array_equal(out["a"], np.ones(3, np.float32))
