@@ -34,10 +34,12 @@ from ...core.tensor import TapeNode, Tensor, _wrap_outputs, is_grad_enabled
 from ...nn.layer import Layer
 
 __all__ = ["SparseTable", "SSDSparseTable", "DistributedEmbedding",
+           "GraphTable", "GraphService", "GraphClient",
            "PSClient", "PSServerHandle", "AsyncCommunicator",
            "GeoCommunicator", "run_server", "role_from_env",
            "server_endpoints_from_env"]
 
+from .graph import GraphClient, GraphService, GraphTable  # noqa: E402
 from .service import (AsyncCommunicator, GeoCommunicator,  # noqa: E402
                       PSClient, PSServerHandle, role_from_env, run_server,
                       server_endpoints_from_env)

@@ -1,0 +1,307 @@
+"""Graph table + service: the PS stack's graph-learning tail.
+
+reference parity: paddle/fluid/distributed/table/common_graph_table.h:1
+(GraphTable: typed nodes/edges, neighbor sampling, node features) and
+service/graph_brpc_server.cc (the brpc service exposing it to trainers
+for GNN pipelines).
+
+TPU-native redesign: graph sampling is HOST work feeding device batches
+— the table lives in host RAM as CSR adjacency per edge type (numpy,
+vectorized sampling) and serves either in-process (the usual pod
+layout: every worker's host holds a shard) or over the same
+length-prefixed TCP framing the C++ parameter server uses
+(`GraphService`/`GraphClient`, python — the hot path of a GNN step is
+the sampler, which is numpy-vectorized; the dense/sparse parameter
+traffic stays on the C++ server).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["GraphTable", "GraphService", "GraphClient"]
+
+
+class GraphTable:
+    """Typed graph in host memory (reference: common_graph_table.h).
+
+    Edges are grouped by ``edge_type``; ``build()`` freezes them into CSR
+    for vectorized neighbor sampling. Node features are named dense
+    arrays keyed by node id.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._pending: Dict[str, List] = {}
+        self._csr: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        self._nodes: Dict[str, np.ndarray] = {}      # node_type -> ids
+        self._feats: Dict[str, Dict[str, np.ndarray]] = {}  # name->{id->row}
+        self._feat_store: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self._rng = np.random.default_rng(seed)
+
+    # -- construction ------------------------------------------------------
+    def add_graph_node(self, node_type: str, ids) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        prev = self._nodes.get(node_type)
+        self._nodes[node_type] = ids if prev is None else \
+            np.unique(np.concatenate([prev, ids]))
+
+    def add_edges(self, edge_type: str, src, dst) -> None:
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if len(src) != len(dst):
+            raise ValueError("src/dst length mismatch")
+        self._pending.setdefault(edge_type, []).append((src, dst))
+        self._csr.pop(edge_type, None)       # invalidate built form
+
+    def set_node_feat(self, feat_name: str, ids, rows) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        rows = rows.reshape(len(ids), -1)
+        old_ids, old_rows = self._feat_store.get(
+            feat_name, (np.empty(0, np.int64),
+                        np.empty((0, rows.shape[1]), np.float32)))
+        keep = ~np.isin(old_ids, ids)
+        merged_ids = np.concatenate([old_ids[keep], ids])
+        merged_rows = np.concatenate([old_rows[keep], rows])
+        order = np.argsort(merged_ids)      # get_node_feat searchsorts
+        self._feat_store[feat_name] = (merged_ids[order],
+                                       merged_rows[order])
+
+    def build(self) -> None:
+        """Freeze pending edges into CSR (reference: build_sampler)."""
+        for et, chunks in self._pending.items():
+            if et in self._csr:
+                continue
+            src = np.concatenate([s for s, _ in chunks])
+            dst = np.concatenate([d for _, d in chunks])
+            order = np.argsort(src, kind="stable")
+            src, dst = src[order], dst[order]
+            uniq, starts = np.unique(src, return_index=True)
+            indptr = np.append(starts, len(src))
+            self._csr[et] = (uniq, indptr, dst)
+
+    # -- queries -----------------------------------------------------------
+    def _adj(self, edge_type: str):
+        if edge_type not in self._csr:
+            self.build()
+        if edge_type not in self._csr:
+            raise KeyError(f"no edges of type {edge_type!r}")
+        return self._csr[edge_type]
+
+    def sample_neighbors(self, edge_type: str, ids, sample_size: int,
+                         replace: bool = False
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """Up to ``sample_size`` neighbors per id (reference:
+        graph_brpc_server sample_neighbors). Returns (flat_neighbors,
+        counts) — counts[i] neighbors for ids[i], concatenated."""
+        uniq, indptr, dst = self._adj(edge_type)
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        pos = np.searchsorted(uniq, ids)
+        found = (pos < len(uniq)) & (uniq[np.minimum(pos, len(uniq) - 1)]
+                                     == ids)
+        out: List[np.ndarray] = []
+        counts = np.zeros(len(ids), np.int64)
+        for i, (p, ok) in enumerate(zip(pos, found)):
+            if not ok:
+                continue
+            nbrs = dst[indptr[p]:indptr[p + 1]]
+            if len(nbrs) > sample_size and not replace:
+                nbrs = self._rng.choice(nbrs, sample_size, replace=False)
+            elif replace:
+                nbrs = self._rng.choice(nbrs, sample_size, replace=True)
+            counts[i] = len(nbrs)
+            out.append(nbrs)
+        flat = np.concatenate(out) if out else np.empty(0, np.int64)
+        return flat, counts
+
+    def random_sample_nodes(self, node_type: str,
+                            sample_size: int) -> np.ndarray:
+        ids = self._nodes.get(node_type)
+        if ids is None or not len(ids):
+            return np.empty(0, np.int64)
+        k = min(sample_size, len(ids))
+        return self._rng.choice(ids, k, replace=False)
+
+    def get_node_feat(self, feat_name: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        fid, rows = self._feat_store.get(
+            feat_name, (np.empty(0, np.int64),
+                        np.empty((0, 0), np.float32)))
+        dim = rows.shape[1] if rows.size else 0
+        out = np.zeros((len(ids), dim), np.float32)
+        pos = np.searchsorted(fid, ids)
+        ok = (pos < len(fid)) & (fid[np.minimum(pos, len(fid) - 1)] == ids)
+        out[ok] = rows[pos[ok]]
+        return out
+
+    def degree(self, edge_type: str, ids) -> np.ndarray:
+        uniq, indptr, _ = self._adj(edge_type)
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        pos = np.searchsorted(uniq, ids)
+        ok = (pos < len(uniq)) & (uniq[np.minimum(pos, len(uniq) - 1)]
+                                  == ids)
+        deg = np.zeros(len(ids), np.int64)
+        deg[ok] = indptr[pos[ok] + 1] - indptr[pos[ok]]
+        return deg
+
+    # -- checkpoint --------------------------------------------------------
+    def save(self, dirname: str) -> None:
+        os.makedirs(dirname, exist_ok=True)
+        self.build()
+        state = {"csr": self._csr, "nodes": self._nodes,
+                 "feats": self._feat_store}
+        with open(os.path.join(dirname, "graph_table.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+    def load(self, dirname: str) -> None:
+        with open(os.path.join(dirname, "graph_table.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._csr = state["csr"]
+        self._nodes = state["nodes"]
+        self._feat_store = state["feats"]
+        self._pending = {}
+
+
+# ---------------------------------------------------------------------------
+# TCP service (reference: graph_brpc_server.cc) — same length-prefixed
+# framing family as the C++ parameter server.
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("graph service closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("graph service closed")
+        buf += chunk
+    return bytes(buf)
+
+
+class GraphService:
+    """Serve a GraphTable over TCP (threaded; sampling is numpy work that
+    releases the GIL in the hot loops)."""
+
+    def __init__(self, table: GraphTable, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.table = table
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.endpoint = "%s:%d" % self._srv.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                self._srv.settimeout(0.2)
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._client_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _client_loop(self, conn):
+        try:
+            while True:
+                req = pickle.loads(_recv_msg(conn))
+                op = req.pop("op")
+                if op == "stop":
+                    _send_msg(conn, pickle.dumps({"ok": True}))
+                    return
+                try:
+                    fn = getattr(self.table, op)
+                    out = fn(**req)
+                    _send_msg(conn, pickle.dumps({"ok": True,
+                                                  "result": out}))
+                except Exception as e:            # report, keep serving
+                    _send_msg(conn, pickle.dumps({"ok": False,
+                                                  "error": repr(e)}))
+        except (ConnectionError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class GraphClient:
+    """Remote GraphTable with the SAME method surface (reference:
+    GraphBrpcClient)."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._lock = threading.Lock()
+
+    def _call(self, op: str, **kw):
+        with self._lock:
+            _send_msg(self._sock, pickle.dumps({"op": op, **kw}))
+            resp = pickle.loads(_recv_msg(self._sock))
+        if not resp.get("ok"):
+            raise RuntimeError(f"graph service error: {resp.get('error')}")
+        return resp.get("result")
+
+    def add_graph_node(self, node_type, ids):
+        return self._call("add_graph_node", node_type=node_type, ids=ids)
+
+    def add_edges(self, edge_type, src, dst):
+        return self._call("add_edges", edge_type=edge_type, src=src,
+                          dst=dst)
+
+    def set_node_feat(self, feat_name, ids, rows):
+        return self._call("set_node_feat", feat_name=feat_name, ids=ids,
+                          rows=rows)
+
+    def build(self):
+        return self._call("build")
+
+    def sample_neighbors(self, edge_type, ids, sample_size,
+                         replace=False):
+        return self._call("sample_neighbors", edge_type=edge_type,
+                          ids=ids, sample_size=sample_size,
+                          replace=replace)
+
+    def random_sample_nodes(self, node_type, sample_size):
+        return self._call("random_sample_nodes", node_type=node_type,
+                          sample_size=sample_size)
+
+    def get_node_feat(self, feat_name, ids):
+        return self._call("get_node_feat", feat_name=feat_name, ids=ids)
+
+    def degree(self, edge_type, ids):
+        return self._call("degree", edge_type=edge_type, ids=ids)
+
+    def close(self):
+        try:
+            self._call("stop")
+        except Exception:
+            pass
+        self._sock.close()

@@ -1,0 +1,85 @@
+"""PS graph table + service (reference:
+fluid/distributed/table/common_graph_table.h:1,
+service/graph_brpc_server.cc)."""
+
+import numpy as np
+
+from paddle_tpu.distributed.ps.graph import (GraphClient, GraphService,
+                                             GraphTable)
+
+
+def _toy_table():
+    t = GraphTable(seed=0)
+    t.add_graph_node("user", [0, 1, 2, 3])
+    # star around 0 plus a chain
+    t.add_edges("follows", src=[0, 0, 0, 1, 2], dst=[1, 2, 3, 2, 3])
+    t.build()
+    return t
+
+
+def test_sample_neighbors_and_degree():
+    t = _toy_table()
+    flat, counts = t.sample_neighbors("follows", [0, 1, 9], sample_size=2)
+    assert counts.tolist()[1] == 1 and counts[2] == 0
+    assert counts[0] == 2                      # capped at sample_size
+    assert set(flat[:2]).issubset({1, 2, 3})
+    assert flat[2] == 2                        # node 1's only neighbor
+    np.testing.assert_array_equal(t.degree("follows", [0, 1, 2, 9]),
+                                  [3, 1, 1, 0])
+
+
+def test_sample_with_replacement_and_incremental_edges():
+    t = _toy_table()
+    flat, counts = t.sample_neighbors("follows", [1], sample_size=4,
+                                      replace=True)
+    assert counts[0] == 4 and set(flat) == {2}
+    t.add_edges("follows", src=[1], dst=[3])   # invalidates + rebuilds
+    np.testing.assert_array_equal(t.degree("follows", [1]), [2])
+
+
+def test_node_feats_roundtrip_and_random_nodes():
+    t = _toy_table()
+    t.set_node_feat("emb", [1, 3], np.asarray([[1., 2.], [3., 4.]],
+                                              np.float32))
+    out = t.get_node_feat("emb", [3, 1, 7])
+    np.testing.assert_allclose(out, [[3., 4.], [1., 2.], [0., 0.]])
+    t.set_node_feat("emb", [1], np.asarray([[9., 9.]], np.float32))
+    np.testing.assert_allclose(t.get_node_feat("emb", [1]), [[9., 9.]])
+    ids = t.random_sample_nodes("user", 3)
+    assert len(ids) == 3 and set(ids).issubset({0, 1, 2, 3})
+    assert len(t.random_sample_nodes("user", 99)) == 4
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = _toy_table()
+    t.set_node_feat("emb", [0], np.ones((1, 4), np.float32))
+    t.save(str(tmp_path))
+    t2 = GraphTable()
+    t2.load(str(tmp_path))
+    np.testing.assert_array_equal(t2.degree("follows", [0]), [3])
+    np.testing.assert_allclose(t2.get_node_feat("emb", [0]),
+                               np.ones((1, 4), np.float32))
+
+
+def test_graph_service_over_tcp():
+    svc = GraphService(GraphTable(seed=1))
+    try:
+        c = GraphClient(svc.endpoint)
+        c.add_graph_node("item", [10, 11, 12])
+        c.add_edges("clicks", src=[10, 10, 11], dst=[11, 12, 12])
+        c.build()
+        flat, counts = c.sample_neighbors("clicks", [10, 11],
+                                          sample_size=5)
+        assert counts.tolist() == [2, 1]
+        assert set(flat[:2]) == {11, 12} and flat[2] == 12
+        c.set_node_feat("f", [10], np.full((1, 3), 7.0, np.float32))
+        np.testing.assert_allclose(c.get_node_feat("f", [10]),
+                                   [[7., 7., 7.]])
+        # errors propagate without killing the connection
+        import pytest
+        with pytest.raises(RuntimeError, match="graph service error"):
+            c.sample_neighbors("nope", [1], sample_size=1)
+        assert c.degree("clicks", [10]).tolist() == [2]
+        c.close()
+    finally:
+        svc.stop()
