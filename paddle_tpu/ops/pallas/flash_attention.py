@@ -915,6 +915,15 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    # tuning override without touching call sites (block sweeps on real
+    # hardware; see docs/PERF_GPT.md)
+    import os
+    env_q = os.environ.get("PTPU_FLASH_BLOCK_Q")
+    env_k = os.environ.get("PTPU_FLASH_BLOCK_K")
+    if env_q:
+        block_q = int(env_q)
+    if env_k:
+        block_k = int(env_k)
     block_q = _pick_block(Sq, block_q)
     block_k = _pick_block(Sk, block_k)
     if scale is None:

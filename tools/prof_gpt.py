@@ -107,6 +107,8 @@ MODES = {
     "base_mt": dict(mt=True),
     "mt_bf16st": dict(mt=True, state_dtype="bfloat16"),
     "bf16st": dict(state_dtype="bfloat16"),
+    "b16_bf16st": dict(B=16, state_dtype="bfloat16"),
+    "b12_bf16st": dict(B=12, state_dtype="bfloat16"),
     "b12_mt": dict(B=12, mt=True),
     "fwdonly": dict(fwd_only=True),
     "gradsonly": dict(grads_only=True),
