@@ -352,3 +352,33 @@ def test_recorded_branch_rejects_buffer_writes():
             static.nn.cond(x.sum() > 0,
                            lambda: bn(x),
                            lambda: x)
+
+
+def test_recorded_nested_cond_inside_while():
+    """cond nested inside a while body records into the while's
+    SUB-program (the recorder stack nests, matching the reference's
+    nested sub-blocks) and replays correctly for different feeds."""
+    main = static.Program()
+    with static.program_guard(main):
+        n = static.data("n", [], "int32")
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+
+        def body(i, s):
+            # +2 on even steps, +10 on odd steps
+            inc = static.nn.cond(i % 2 == 0,
+                                 lambda: paddle.to_tensor(np.float32(2.0)),
+                                 lambda: paddle.to_tensor(np.float32(10.0)))
+            return [i + 1, s + inc]
+
+        i_out, s_out = static.nn.while_loop(lambda i, s: i < n, body,
+                                            [i, s])
+    exe = static.Executor()
+
+    def ref(k):
+        return float(sum(2.0 if j % 2 == 0 else 10.0 for j in range(k)))
+
+    for k in (4, 7):
+        (iv, sv) = exe.run(main, feed={"n": np.int32(k)},
+                           fetch_list=[i_out, s_out])
+        assert int(iv) == k and float(sv) == ref(k), (k, sv, ref(k))
