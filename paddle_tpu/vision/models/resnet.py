@@ -1,6 +1,15 @@
-"""ResNet family (reference: python/paddle/vision/models/resnet.py)."""
+"""ResNet family (reference: python/paddle/vision/models/resnet.py).
+
+``data_format="NHWC"`` runs the whole network channels-last internally
+(convs, BNs, pools) while keeping the public NCHW input contract — the
+input is transposed ONCE at entry. On TPU the channels-last layout is
+the MXU-native one for convolutions; see docs/PERF_GPT.md's ResNet
+note for measured numbers.
+"""
 
 from __future__ import annotations
+
+import functools
 
 from ... import nn
 
@@ -11,14 +20,16 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
+                               bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -36,16 +47,20 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=data_format)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
-                               groups=groups, dilation=dilation, bias_attr=False)
+                               groups=groups, dilation=dilation,
+                               bias_attr=False, data_format=data_format)
         self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
+                               bias_attr=False, data_format=data_format)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -63,7 +78,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -74,21 +89,26 @@ class ResNet(nn.Layer):
         self.base_width = width
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self._norm_layer = nn.BatchNorm2D
+        self.data_format = data_format
+        self._norm_layer = (nn.BatchNorm2D if data_format == "NCHW" else
+                            functools.partial(nn.BatchNorm2D,
+                                              data_format=data_format))
         self.inplanes = 64
         self.dilation = 1
 
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
+                               bias_attr=False, data_format=data_format)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -98,19 +118,27 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
                 norm_layer(planes * block.expansion),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, 1, norm_layer)]
+                        self.base_width, 1, norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
+        if self.data_format == "NHWC":
+            # public contract stays NCHW; one transpose at entry puts the
+            # whole network on the TPU-native channels-last layout
+            from ...tensor.manipulation import transpose
+            x = transpose(x, [0, 2, 3, 1])
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)

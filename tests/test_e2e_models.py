@@ -138,3 +138,21 @@ def test_hapi_model_fit():
     res = model.evaluate(Squeeze(MNIST(mode="test", num_synthetic=64)),
                          batch_size=32)
     assert "loss" in res
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" runs the net channels-last internally with the
+    same params and the SAME numerics (public input stays NCHW)."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    m1 = resnet18(num_classes=10)
+    paddle.seed(0)
+    m2 = resnet18(num_classes=10, data_format="NHWC")
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(2, 3, 64, 64)).astype(np.float32))
+    m1.eval()
+    m2.eval()
+    with paddle.no_grad():
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(),
+                                   atol=2e-3, rtol=1e-3)

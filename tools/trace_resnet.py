@@ -28,8 +28,9 @@ def main():
     from paddle_tpu.vision.models import resnet50
 
     B = int(os.environ.get("RN_B", "256"))
+    fmt = os.environ.get("RN_FMT", "NCHW")
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, data_format=fmt)
 
     def loss_fn(layer, xb, yb):
         with paddle.amp.auto_cast(level="O1"):
@@ -51,7 +52,7 @@ def main():
     for _ in range(5):
         out = step(x, y)
     float(out)
-    log(f"resnet50 B={B}: {(time.perf_counter()-t0)/5*1e3:.1f} ms/step")
+    log(f"resnet50 B={B} {fmt}: {(time.perf_counter()-t0)/5*1e3:.1f} ms/step")
 
     tdir = "/tmp/rn_trace"
     os.system(f"rm -rf {tdir}")
