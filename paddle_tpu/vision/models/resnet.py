@@ -23,7 +23,8 @@ class BasicBlock(nn.Layer):
                  base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
                                bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(planes)
@@ -50,7 +51,8 @@ class BottleneckBlock(nn.Layer):
                  base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
                                data_format=data_format)
@@ -85,14 +87,16 @@ class ResNet(nn.Layer):
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
         }
         layers = layer_cfg[depth]
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(
+                f"data_format must be 'NCHW' or 'NHWC', got {data_format!r}")
         self.groups = groups
         self.base_width = width
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.data_format = data_format
-        self._norm_layer = (nn.BatchNorm2D if data_format == "NCHW" else
-                            functools.partial(nn.BatchNorm2D,
-                                              data_format=data_format))
+        self._norm_layer = functools.partial(nn.BatchNorm2D,
+                                             data_format=data_format)
         self.inplanes = 64
         self.dilation = 1
 
@@ -134,9 +138,12 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        if self.data_format == "NHWC":
-            # public contract stays NCHW; one transpose at entry puts the
-            # whole network on the TPU-native channels-last layout
+        nhwc = self.data_format == "NHWC"
+        if nhwc:
+            # public contract stays NCHW on BOTH ends; one transpose at
+            # entry puts the network on the TPU-native channels-last
+            # layout, one at exit restores it whenever the tensor leaves
+            # with spatial dims
             from ...tensor.manipulation import transpose
             x = transpose(x, [0, 2, 3, 1])
         x = self.relu(self.bn1(self.conv1(x)))
@@ -147,6 +154,9 @@ class ResNet(nn.Layer):
         x = self.layer4(x)
         if self.with_pool:
             x = self.avgpool(x)
+        if nhwc:
+            from ...tensor.manipulation import transpose
+            x = transpose(x, [0, 3, 1, 2])
         if self.num_classes > 0:
             from ...tensor.manipulation import flatten
             x = flatten(x, 1)

@@ -156,3 +156,29 @@ def test_resnet_nhwc_matches_nchw():
     with paddle.no_grad():
         np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(),
                                    atol=2e-3, rtol=1e-3)
+
+
+def test_resnet_nhwc_backbone_contract_and_validation():
+    """The NCHW contract holds on BOTH ends: a headless/unpooled NHWC
+    backbone returns NCHW features matching its NCHW twin; bad
+    data_format values raise."""
+    import pytest
+
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(1)
+    b1 = resnet18(num_classes=0, with_pool=False)
+    paddle.seed(1)
+    b2 = resnet18(num_classes=0, with_pool=False, data_format="NHWC")
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .normal(size=(2, 3, 64, 64)).astype(np.float32))
+    b1.eval()
+    b2.eval()
+    with paddle.no_grad():
+        f1 = b1(x).numpy()
+        f2 = b2(x).numpy()
+    assert f1.shape == f2.shape            # NCHW out either way
+    np.testing.assert_allclose(f1, f2, atol=2e-3, rtol=1e-3)
+
+    with pytest.raises(ValueError, match="data_format"):
+        resnet18(data_format="nhwc")
