@@ -528,6 +528,30 @@ def main() -> None:
         if m is not None:
             print(json.dumps(m), flush=True)
 
+    # self-gate against the newest driver record so a regression is
+    # visible in this run's own log (the CLI gate remains for CI use)
+    try:
+        import glob
+        import os
+        recs = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+        if recs:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import check_bench
+            with open(recs[-1]) as f:
+                old = check_bench._metric_list(json.load(f))
+            problems = check_bench.compare(
+                old, [m for m in metrics if m is not None])
+            for p in problems:
+                log("BENCH GATE vs " + os.path.basename(recs[-1]) + ": "
+                    + p)
+            if old and not problems:
+                log(f"bench gate ok vs {os.path.basename(recs[-1])}: "
+                    "no metric regressed beyond 10%")
+    except Exception as e:                       # the gate must never sink
+        log(f"bench gate skipped: {e!r}")        # the metrics themselves
+
 
 if __name__ == "__main__":
     main()
