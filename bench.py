@@ -541,7 +541,10 @@ def main() -> None:
             import check_bench
             with open(recs[-1]) as f:
                 old = check_bench._metric_list(json.load(f))
-            problems = check_bench.compare(
+            # intersection-only: a --quick run (or a failed diagnostic
+            # leg) intentionally skips benchmarks — those must not log as
+            # "metric disappeared" regressions in the self-gate
+            problems = check_bench.compare_common(
                 old, [m for m in metrics if m is not None])
             for p in problems:
                 log("BENCH GATE vs " + os.path.basename(recs[-1]) + ": "

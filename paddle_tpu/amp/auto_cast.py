@@ -53,8 +53,9 @@ black_list = {
 
 # Never cast, at ANY level: the op preserves its inputs' dtypes and runs
 # f32 statistics internally; a blanket cast would also hit its f32 state
-# buffers (see _cast_target).
-_keep_dtype = {"batch_norm"}
+# buffers (see _cast_target). fused_conv_bn resolves the conv-operand cast
+# itself (nn/functional.py) so its f32 EMA buffers ride through untouched.
+_keep_dtype = {"batch_norm", "fused_conv_bn"}
 
 _tls = threading.local()
 

@@ -87,6 +87,17 @@ define_flag("tpu_matmul_precision", "highest",
             "'highest' = full f32 (reference CUDA parity); 'default' lets the "
             "backend pick (bf16 passes on TPU). Convolutions follow the XLA "
             "backend default; use AMP/bf16 for the MXU fast path.")
+define_flag("jit_channels_last", True,
+            "Run 2-D NCHW conv/BN/pool chains channels-last (NHWC, the TPU "
+            "MXU-native conv layout) inside jitted TrainStep traces: one "
+            "transpose at model entry/exit instead of per-op NCHW dimension "
+            "numbers. Public API layout is unchanged (docs/PARITY.md, "
+            "internal-layout contract).")
+define_flag("fused_conv_bn", True,
+            "Fuse Conv2D+BatchNorm(+ReLU) chains in the vision models into "
+            "one op (nn.functional.fused_conv_bn): conv epilogue fusion in "
+            "XLA, one tape node in eager. f32 EMA buffers preserved under "
+            "AMP.")
 define_flag("log_level", "0", "Verbose log level (VLOG analogue).")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "

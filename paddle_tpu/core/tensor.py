@@ -131,7 +131,8 @@ class Tensor:
     """
 
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
-                 "name", "persistable", "_retain_grads", "_hooks", "__weakref__")
+                 "name", "persistable", "_retain_grads", "_hooks", "_layout",
+                 "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -155,6 +156,10 @@ class Tensor:
         self.persistable = False
         self._retain_grads = False
         self._hooks: List[Callable] = []
+        # internal-layout tag (nn.layout planner): "NHWC" marks a tensor
+        # whose physical layout is channels-last while the logical API
+        # contract stays NCHW; None for ordinary tensors
+        self._layout: Optional[str] = None
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -252,6 +257,7 @@ class Tensor:
         new.persistable = self.persistable
         new._retain_grads = False
         new._hooks = []
+        new._layout = getattr(self, "_layout", None)
         for slot in getattr(cls, "__slots__", ()):
             if slot in Tensor.__slots__ or slot == "__weakref__":
                 continue
@@ -346,6 +352,7 @@ class Tensor:
         if self._node is not None:
             self._node.out_refs[self._out_idx] = weakref.ref(self)
         self.stop_gradient = other.stop_gradient
+        self._layout = other._layout
 
     # NOTE: arithmetic dunders and the broad method surface are attached by
     # paddle_tpu.tensor (functional API) at import time to avoid circularity.
@@ -387,6 +394,19 @@ def _unwrap_index(idx):
 
 _amp_target_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
 _op_profile_hook: Optional[Callable] = None  # installed by paddle_tpu.profiler
+# installed by paddle_tpu.nn.layout: (pre, post) planner callbacks. pre may
+# rewrite args (insert the one exit transpose in front of a layout-unaware
+# op); post propagates the channels-last tag through layout-transparent ops.
+_layout_pre_hook: Optional[Callable] = None
+_layout_post_hook: Optional[Callable] = None
+
+
+def set_layout_hooks(pre: Optional[Callable], post: Optional[Callable]):
+    """Install the internal-layout planner callbacks (nn.layout). Both are
+    no-ops unless a channels-last scope is active on the calling thread."""
+    global _layout_pre_hook, _layout_post_hook
+    _layout_pre_hook = pre
+    _layout_post_hook = post
 
 # Eager-op jit cache (FLAGS_eager_jit_ops, reference analogue: the op-cache
 # the reference's dygraph tracer maintains per op+sig, imperative/
@@ -401,6 +421,9 @@ import collections as _collections
 
 _EAGER_FN_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
 _EAGER_FN_CACHE_MAX = 1024
+# dispatch-cache observability (tests + the eager bench): counts since
+# interpreter start; reset freely from diagnostics
+_EAGER_CACHE_STATS = {"hits": 0, "misses": 0}
 
 
 def _eager_cacheable(fn, static_kw) -> bool:
@@ -495,17 +518,29 @@ def record_mutation(target, new_value):
         else new_value
 
 
-def apply(fn: Callable, *args, name: str = "", **static_kw):
+def apply(fn: Callable, *args, name: str = "", _cache_token=None, **static_kw):
     """Execute ``fn`` over raw arrays; record a VJP tape node if needed;
     when a static-graph recorder is active (static.program_guard), also
-    append the op to the recording program for feed/fetch replay."""
-    result = _apply_impl(fn, *args, name=name, **static_kw)
+    append the op to the recording program for feed/fetch replay.
+
+    ``_cache_token`` opts a closure-built op into the eager jit cache: a
+    hashable token that must encode EVERY closure-captured value affecting
+    the op's semantics (stride/padding/axis/...); the cache key becomes
+    (name, token, signatures) instead of the function identity, so the
+    per-call-site fresh closures of nn.functional stop defeating the cache."""
+    if _layout_pre_hook is not None:
+        args = _layout_pre_hook(name, args)
+    result = _apply_impl(fn, *args, name=name, _cache_token=_cache_token,
+                         **static_kw)
+    if _layout_post_hook is not None:
+        _layout_post_hook(name, args, result)
     if _static_recorders:
         _static_recorders[-1]._record_op(fn, name, static_kw, args, result)
     return result
 
 
-def _apply_impl(fn: Callable, *args, name: str = "", **static_kw):
+def _apply_impl(fn: Callable, *args, name: str = "", _cache_token=None,
+                **static_kw):
     """Execute ``fn`` over raw arrays; record a VJP tape node if needed.
 
     ``args`` may mix Tensors and array-likes/scalars; only float Tensor args
@@ -573,7 +608,9 @@ def _apply_impl(fn: Callable, *args, name: str = "", **static_kw):
         t0 = _time.perf_counter()
 
     cached = None
-    if get_flag("eager_jit_ops") and _eager_cacheable(fn, static_kw) \
+    if get_flag("eager_jit_ops") \
+            and (_cache_token is not None
+                 or _eager_cacheable(fn, static_kw)) \
             and all(hasattr(a, "shape") for a in raw):
         # all-array args only: jitting would trace positional python
         # scalars that the fn may use structurally (axis/shape values)
@@ -583,7 +620,13 @@ def _apply_impl(fn: Callable, *args, name: str = "", **static_kw):
             # therefore be part of the cache key — an op traced under one
             # autocast policy cannot serve another
             amp_token = _amp_target
-            key = (id(fn), name, tuple(diff_idx),
+            # token-keyed ops: nn.functional builds a FRESH closure per
+            # call, so fn identity would never repeat — the caller-supplied
+            # token (encoding every captured config value) replaces it in
+            # the key, and the first call's closures serve all later calls
+            # with the same (name, token, signature, amp) tuple
+            key = (_cache_token if _cache_token is not None else id(fn),
+                   name, tuple(diff_idx),
                    tuple((a.shape, str(a.dtype)) for a in raw),
                    amp_token,
                    tuple(sorted(static_kw.items())) if static_kw else ())
@@ -591,6 +634,9 @@ def _apply_impl(fn: Callable, *args, name: str = "", **static_kw):
         except TypeError:
             key = None
         cached = _eager_cache_get(key) if key is not None else None
+        if key is not None:
+            _EAGER_CACHE_STATS["hits" if cached is not None
+                               else "misses"] += 1
         if cached is None and key is not None:
             def fwd_fn(vals):
                 vals = _amp(vals)

@@ -26,6 +26,7 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["save", "load", "wait", "save_train_step", "load_train_step",
@@ -198,14 +199,26 @@ def load_train_step(step, path: str):
 
     target = _train_step_target(step)
     state = load(path, target=target)
-    step.params = dict(state["params"])
-    step.frozen = dict(state["frozen"])
-    step.buffers = dict(state["buffers"])
+
+    # Re-materialize every restored leaf into a fresh framework-owned
+    # device buffer (sharding-preserving). The restore hands back arrays
+    # whose storage the checkpoint layer owns; feeding those straight into
+    # the TrainStep's donated executable makes XLA free/alias foreign
+    # buffers — a hard crash (SIGSEGV on XLA:CPU) on the first step after
+    # a reshard-on-load. One copy per leaf at restore time is noise next
+    # to checkpoint I/O.
+    def _own(a):
+        return jnp.copy(a) if isinstance(a, jax.Array) else a
+
+    step.params = jax.tree_util.tree_map(_own, dict(state["params"]))
+    step.frozen = jax.tree_util.tree_map(_own, dict(state["frozen"]))
+    step.buffers = jax.tree_util.tree_map(_own, dict(state["buffers"]))
     # rebuild the optimizer's native container structure (listified for
     # serialization) from the restored leaves
     step.opt_state = jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(step.opt_state),
-        jax.tree_util.tree_leaves(state["opt_state"]))
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(_own, state["opt_state"])))
     step.step_count = int(state["step_count"])
     # restore starts a fresh gradient-accumulation window
     step._acc_grads = None

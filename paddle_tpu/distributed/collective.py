@@ -203,7 +203,7 @@ def _eager_shardmap(group: Group, key, body, n_out_stacked=True):
     f = _eager_cache.get(ck)
     if f is None:
         ax = group.axis_name
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(env.shard_map(
             body, mesh=group.mesh, in_specs=P(ax), out_specs=P(ax),
             check_vma=False))
         _eager_cache[ck] = f

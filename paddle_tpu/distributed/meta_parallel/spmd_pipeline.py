@@ -212,7 +212,7 @@ class PipelineStageStack(Layer):
         # partial-manual shard_map (manual pp, auto dp/mp/sp) is only
         # legal under jit; jax.jit inlines when we are already inside an
         # outer trace and compiles (once, cached) for eager calls
-        pipe = jax.jit(jax.shard_map(
+        pipe = jax.jit(dist_env.shard_map(
             shard_body, mesh=mesh,
             in_specs=(P(), P()) + (P(axis),) * len(rnames),
             out_specs=P(), axis_names={axis}, check_vma=False))

@@ -13,6 +13,17 @@ length-prefixed TCP framing the C++ parameter server uses
 (`GraphService`/`GraphClient`, python — the hot path of a GNN step is
 the sampler, which is numpy-vectorized; the dense/sparse parameter
 traffic stays on the C++ server).
+
+Trust model / wire safety: the TCP protocol is the same typed
+struct+numpy framing family as ps/service.py — an op name plus
+primitively-typed fields (ints/floats/bools/strings) and dtyped numpy
+buffers. NO pickle and no other code-bearing encoding crosses the
+socket in either direction, the server dispatches only the explicit
+method allowlist below (never getattr on attacker-chosen names), and
+ndarray decoding is restricted to a numeric-dtype allowlist, so a
+malicious peer can at worst feed wrong graph data. The protocol still
+has no authentication or encryption: bind to loopback (the default) or
+deploy on a trusted pod network, exactly like the C++ parameter server.
 """
 
 from __future__ import annotations
@@ -57,6 +68,15 @@ class GraphTable:
         dst = np.asarray(dst, np.int64).reshape(-1)
         if len(src) != len(dst):
             raise ValueError("src/dst length mismatch")
+        if edge_type in self._csr and edge_type not in self._pending:
+            # CSR exists with no pending source chunks — this edge type
+            # came from load(), which clears _pending. Decompose the CSR
+            # back into a pending chunk BEFORE invalidating, or the next
+            # build() would rebuild from the new edges alone and silently
+            # drop everything previously loaded.
+            uniq, indptr, csr_dst = self._csr[edge_type]
+            csr_src = np.repeat(uniq, np.diff(indptr))
+            self._pending[edge_type] = [(csr_src, csr_dst)]
         self._pending.setdefault(edge_type, []).append((src, dst))
         self._csr.pop(edge_type, None)       # invalidate built form
 
@@ -171,8 +191,106 @@ class GraphTable:
 
 # ---------------------------------------------------------------------------
 # TCP service (reference: graph_brpc_server.cc) — same length-prefixed
-# framing family as the C++ parameter server.
+# framing family as the C++ parameter server. Messages are typed fields
+# (see the module docstring's trust model): no pickle on the wire.
 # ---------------------------------------------------------------------------
+
+# field type tags
+_T_NONE, _T_BOOL, _T_INT, _T_FLOAT, _T_STR, _T_NDARRAY, _T_LIST = range(7)
+
+# only plain numeric buffers may decode into arrays: object/void/structured
+# dtypes never cross the wire
+_DTYPE_ALLOW = frozenset("biufc")
+
+
+def _pack_value(v) -> bytes:
+    if v is None:
+        return struct.pack("<B", _T_NONE)
+    if isinstance(v, (bool, np.bool_)):
+        return struct.pack("<BB", _T_BOOL, int(v))
+    if isinstance(v, (int, np.integer)):
+        return struct.pack("<Bq", _T_INT, int(v))
+    if isinstance(v, (float, np.floating)):
+        return struct.pack("<Bd", _T_FLOAT, float(v))
+    if isinstance(v, str):
+        raw = v.encode("utf-8")
+        return struct.pack("<BI", _T_STR, len(raw)) + raw
+    if isinstance(v, (list, tuple)):
+        parts = [struct.pack("<BI", _T_LIST, len(v))]
+        parts += [_pack_value(x) for x in v]
+        return b"".join(parts)
+    arr = np.ascontiguousarray(v)
+    if arr.dtype.kind not in _DTYPE_ALLOW:
+        raise TypeError(f"graph wire protocol cannot carry dtype {arr.dtype}")
+    dt = arr.dtype.str.encode("ascii")
+    hdr = struct.pack("<BBB", _T_NDARRAY, len(dt), arr.ndim)
+    shape = struct.pack(f"<{arr.ndim}q", *arr.shape)
+    return hdr + dt + shape + arr.tobytes()
+
+
+def _unpack_value(buf: memoryview, off: int):
+    tag = buf[off]
+    off += 1
+    if tag == _T_NONE:
+        return None, off
+    if tag == _T_BOOL:
+        return bool(buf[off]), off + 1
+    if tag == _T_INT:
+        return struct.unpack_from("<q", buf, off)[0], off + 8
+    if tag == _T_FLOAT:
+        return struct.unpack_from("<d", buf, off)[0], off + 8
+    if tag == _T_STR:
+        n = struct.unpack_from("<I", buf, off)[0]
+        off += 4
+        return bytes(buf[off:off + n]).decode("utf-8"), off + n
+    if tag == _T_LIST:
+        n = struct.unpack_from("<I", buf, off)[0]
+        off += 4
+        out = []
+        for _ in range(n):
+            v, off = _unpack_value(buf, off)
+            out.append(v)
+        return out, off
+    if tag == _T_NDARRAY:
+        dt_len, ndim = buf[off], buf[off + 1]
+        off += 2
+        dt = np.dtype(bytes(buf[off:off + dt_len]).decode("ascii"))
+        off += dt_len
+        if dt.kind not in _DTYPE_ALLOW:
+            raise TypeError(f"refusing wire dtype {dt}")
+        shape = struct.unpack_from(f"<{ndim}q", buf, off)
+        off += 8 * ndim
+        count = int(np.prod(shape)) if shape else 1
+        nbytes = count * dt.itemsize
+        arr = np.frombuffer(buf[off:off + nbytes], dtype=dt).reshape(shape)
+        return arr.copy(), off + nbytes
+    raise ValueError(f"unknown wire tag {tag}")
+
+
+def _pack_fields(fields: Dict[str, object]) -> bytes:
+    parts = [struct.pack("<I", len(fields))]
+    for k, v in fields.items():
+        raw = k.encode("utf-8")
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
+        parts.append(_pack_value(v))
+    return b"".join(parts)
+
+
+def _unpack_fields(payload: bytes) -> Dict[str, object]:
+    buf = memoryview(payload)
+    n = struct.unpack_from("<I", buf, 0)[0]
+    off = 4
+    out: Dict[str, object] = {}
+    for _ in range(n):
+        klen = struct.unpack_from("<I", buf, off)[0]
+        off += 4
+        k = bytes(buf[off:off + klen]).decode("utf-8")
+        off += klen
+        v, off = _unpack_value(buf, off)
+        out[k] = v
+    return out
+
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
@@ -195,9 +313,18 @@ def _recv_msg(sock: socket.socket) -> bytes:
     return bytes(buf)
 
 
+# remote-callable surface: dispatch NEVER getattrs an attacker-chosen
+# name, and host-side file I/O (save/load) is deliberately NOT remote
+_SERVICE_OPS = frozenset({
+    "add_graph_node", "add_edges", "set_node_feat", "build",
+    "sample_neighbors", "random_sample_nodes", "get_node_feat", "degree",
+})
+
+
 class GraphService:
     """Serve a GraphTable over TCP (threaded; sampling is numpy work that
-    releases the GIL in the hot loops)."""
+    releases the GIL in the hot loops). Wire format: typed struct+numpy
+    fields — see the module docstring's trust model."""
 
     def __init__(self, table: GraphTable, host: str = "127.0.0.1",
                  port: int = 0):
@@ -226,20 +353,31 @@ class GraphService:
     def _client_loop(self, conn):
         try:
             while True:
-                req = pickle.loads(_recv_msg(conn))
-                op = req.pop("op")
+                req = _unpack_fields(_recv_msg(conn))
+                op = req.pop("op", None)
+                if not isinstance(op, str):
+                    _send_msg(conn, _pack_fields(
+                        {"ok": False, "error": "request missing 'op'"}))
+                    continue
                 if op == "stop":
-                    _send_msg(conn, pickle.dumps({"ok": True}))
+                    _send_msg(conn, _pack_fields({"ok": True}))
                     return
                 try:
-                    fn = getattr(self.table, op)
-                    out = fn(**req)
-                    _send_msg(conn, pickle.dumps({"ok": True,
+                    if op not in _SERVICE_OPS:
+                        raise ValueError(f"unknown graph op {op!r}")
+                    out = getattr(self.table, op)(**req)
+                    if isinstance(out, tuple):
+                        out = list(out)
+                    _send_msg(conn, _pack_fields({"ok": True,
                                                   "result": out}))
                 except Exception as e:            # report, keep serving
-                    _send_msg(conn, pickle.dumps({"ok": False,
+                    _send_msg(conn, _pack_fields({"ok": False,
                                                   "error": repr(e)}))
-        except (ConnectionError, EOFError):
+        except (ConnectionError, EOFError, ValueError, KeyError,
+                IndexError, TypeError, struct.error):
+            # disconnected peer or an unparseable frame (truncated payload,
+            # bad tag): close THIS connection quietly; the server and other
+            # connections keep serving
             pass
         finally:
             conn.close()
@@ -263,11 +401,16 @@ class GraphClient:
 
     def _call(self, op: str, **kw):
         with self._lock:
-            _send_msg(self._sock, pickle.dumps({"op": op, **kw}))
-            resp = pickle.loads(_recv_msg(self._sock))
+            _send_msg(self._sock, _pack_fields({"op": op, **kw}))
+            resp = _unpack_fields(_recv_msg(self._sock))
         if not resp.get("ok"):
             raise RuntimeError(f"graph service error: {resp.get('error')}")
-        return resp.get("result")
+        out = resp.get("result")
+        # multi-array results (sample_neighbors) travel as a list
+        if isinstance(out, list) and out and all(
+                isinstance(x, np.ndarray) for x in out):
+            return tuple(out)
+        return out
 
     def add_graph_node(self, node_type, ids):
         return self._call("add_graph_node", node_type=node_type, ids=ids)

@@ -159,5 +159,5 @@ def shard_map(body, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
         with env.axes_bound(*mesh.axis_names):
             return body(*args)
 
-    return jax.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+    return env.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=check_vma)

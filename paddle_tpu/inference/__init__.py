@@ -163,6 +163,11 @@ class Predictor:
 
         layer = config.layer
         layer.eval()
+        if config._ir_optim:
+            # conv+BN weight folding: the one IR-level optimization XLA
+            # cannot perform (it rewrites parameter VALUES); see passes.py
+            from .passes import fold_conv_bn
+            fold_conv_bn(layer)
         if config._weight_quant:
             from ..slim import quantize_weights
             quantize_weights(layer)

@@ -1,0 +1,88 @@
+"""Inference graph passes (the XLA-era analogue of the reference's
+inference/analysis IR passes).
+
+On TPU most "passes" are the XLA compiler; what remains profitable at the
+framework level is WEIGHT transformations that XLA cannot do because they
+change the parameter values themselves. The classic one for vision
+deployments is conv+BN folding (reference analogue:
+inference/analysis/passes + the conv_bn_fuse_pass of framework/ir): at
+inference time BatchNorm is an affine map with frozen statistics, so it
+folds into the preceding conv's weight and bias exactly:
+
+    w' = w * gamma / sqrt(var + eps)        (per out-channel)
+    b' = beta + (b - mean) * gamma / sqrt(var + eps)
+
+after which the BN layer is replaced with Identity — one conv kernel, no
+separate normalization traffic, and the epilogue fusion has nothing left
+to fuse because the work is gone.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fold_conv_bn"]
+
+
+def _fold_containers():
+    """Container types where child-declaration adjacency IS dataflow
+    adjacency, so the fold is provably safe: Sequential bodies run children
+    in order, and the vision zoo's blocks wire convN straight into bnN in
+    forward. An arbitrary user Layer may declare a conv next to a BN it
+    never feeds (parallel branches) — folding there would silently corrupt
+    both branches, so it is excluded from the default pass."""
+    from ..nn.layer import Sequential
+    from ..vision.models.resnet import (BasicBlock, BottleneckBlock,
+                                        ResNet)
+    return (Sequential, ResNet, BasicBlock, BottleneckBlock)
+
+
+def fold_conv_bn(layer, aggressive: bool = False) -> int:
+    """Fold every (Conv2D, BatchNorm) pair of adjacent children into the
+    conv, replacing the BN with Identity. Recurses through the whole layer
+    tree; pairs are folded only inside containers whose declaration order
+    is known to match dataflow (Sequential + the vision zoo blocks) unless
+    ``aggressive=True`` extends the fold to every adjacent pair.
+
+    Mutates ``layer`` in place (call on an eval-mode copy for deployment);
+    returns the number of folded pairs.
+    """
+    from ..core.tensor import Parameter, Tensor
+    from ..nn.layers.common import Identity
+    from ..nn.layers.conv import Conv2D
+    from ..nn.layers.norm import SyncBatchNorm, _BatchNormBase
+
+    folded = 0
+    children = list(layer._sub_layers.items())
+    fold_here = aggressive or isinstance(layer, _fold_containers())
+    for (name_a, a), (name_b, b) in zip(children, children[1:]):
+        if not fold_here:
+            break
+        if not (type(a) is Conv2D and isinstance(b, _BatchNormBase)
+                and not isinstance(b, SyncBatchNorm)):
+            continue
+        gamma = b.weight._data.astype(jnp.float32) if b.weight is not None \
+            else jnp.ones_like(b._mean._data)
+        beta = b.bias._data.astype(jnp.float32) if b.bias is not None \
+            else jnp.zeros_like(b._mean._data)
+        mean = b._mean._data.astype(jnp.float32)
+        var = b._variance._data.astype(jnp.float32)
+        scale = gamma / jnp.sqrt(var + b._epsilon)
+        w = a.weight._data
+        # conv weight layout is [out_c, in_c/groups, kh, kw]: scale over
+        # the out-channel axis
+        new_w = (w.astype(jnp.float32)
+                 * scale.reshape((-1,) + (1,) * (w.ndim - 1))).astype(w.dtype)
+        old_b = a.bias._data.astype(jnp.float32) if a.bias is not None \
+            else jnp.zeros_like(mean)
+        new_b = beta + (old_b - mean) * scale
+        a.weight._data = new_w
+        if a.bias is not None:
+            a.bias._data = new_b.astype(a.bias._data.dtype)
+        else:
+            a.bias = Parameter(Tensor(new_b), trainable=False)
+        layer._sub_layers[name_b] = Identity()
+        folded += 1
+    for child in layer._sub_layers.values():
+        folded += fold_conv_bn(child, aggressive=aggressive)
+    return folded

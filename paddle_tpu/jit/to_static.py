@@ -403,7 +403,16 @@ class TrainStep:
     def _loss_and_grads(self, treedef):
         """Shared fwd+bwd kernel: (params, buffers, key, flat_batch) ->
         ((loss, new_bufs), grads)."""
+        from ..core.flags import get_flag
+        from ..nn import layout as nn_layout
         layer, loss_fn, frozen = self.layer, self.loss_fn, self.frozen
+        # automatic NHWC rewrite (FLAGS_jit_channels_last): the trace runs
+        # under the channels-last planner, so any 2-D NCHW conv/BN/pool
+        # chain in the model compiles MXU-native — one layout transpose at
+        # model entry/exit instead of per-op NCHW dimension numbers. Pure
+        # python tracing state: numerics are layout-invariant (covered by
+        # the NCHW/NHWC parity tests) and the flag is read at trace time.
+        channels_last = bool(get_flag("jit_channels_last"))
 
         def run(params, buffers, key, flat_batch):
             batch = jax.tree_util.tree_unflatten(treedef, flat_batch)
@@ -411,7 +420,8 @@ class TrainStep:
             def compute_loss(p):
                 tensors = [Tensor(b) for b in batch]
                 bufs = dict(buffers)
-                with trace_rng(key), no_grad():
+                with trace_rng(key), no_grad(), \
+                        nn_layout.channels_last_scope(channels_last):
                     with bind(layer, {**frozen, **p}, bufs):
                         loss = loss_fn(layer, *tensors)
                 loss_arr = loss._data if isinstance(loss, Tensor) else loss

@@ -1,6 +1,7 @@
 """paddle_tpu.nn — layer library (reference surface: python/paddle/nn/)."""
 
 from . import functional  # noqa: F401
+from . import layout  # noqa: F401  (installs the channels-last planner hooks)
 from . import initializer  # noqa: F401
 from .initializer import ParamAttr  # noqa: F401
 from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

@@ -7,6 +7,7 @@ lax.conv_general_dilated / jnp.matmul; XLA fuses the elementwise epilogues.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence, Union
 
@@ -19,6 +20,7 @@ from ..core.flags import matmul_precision
 from ..core.random import in_trace_rng, make_rng
 from ..core.tensor import (Tensor, annotate_test_variant, apply,
                            record_mutation)
+from . import layout as _layout
 
 __all__ = [
     # activations
@@ -36,8 +38,8 @@ __all__ = [
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     # norm
-    "batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm",
-    "normalize",
+    "batch_norm", "fused_conv_bn", "layer_norm", "instance_norm",
+    "group_norm", "local_response_norm", "normalize",
     # dropout
     "dropout", "dropout2d", "dropout3d", "alpha_dropout",
     # embedding / one-hot
@@ -186,7 +188,8 @@ def softmax(x, axis=-1, dtype=None, name=None):
         if d is not None:
             a = a.astype(d)
         return jax.nn.softmax(a, axis=axis)
-    return apply(_sm, _t(x), name="softmax")
+    return apply(_sm, _t(x), name="softmax",
+                 _cache_token=("softmax", axis, str(d)))
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
@@ -195,7 +198,8 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
         if d is not None:
             a = a.astype(d)
         return jax.nn.log_softmax(a, axis=axis)
-    return apply(_lsm, _t(x), name="log_softmax")
+    return apply(_lsm, _t(x), name="log_softmax",
+                 _cache_token=("log_softmax", axis, str(d)))
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
@@ -220,9 +224,11 @@ def linear(x, weight, bias=None, name=None):
     prec = matmul_precision()
     if bias is None:
         return apply(lambda a, w: jnp.matmul(a, w, precision=prec),
-                     _t(x), _t(weight), name="linear")
+                     _t(x), _t(weight), name="linear",
+                     _cache_token=("linear", str(prec)))
     return apply(lambda a, w, b: jnp.matmul(a, w, precision=prec) + b,
-                 _t(x), _t(weight), _t(bias), name="linear")
+                 _t(x), _t(weight), _t(bias), name="linear",
+                 _cache_token=("linear", str(prec)))
 
 
 def _norm_tuple(v, n):
@@ -231,17 +237,89 @@ def _norm_tuple(v, n):
     return tuple(int(i) for i in v)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _conv_accum_f32(a, w, stride, pad, lhs_dilation, rhs_dilation, dn,
+                    groups):
+    """Low-precision conv with EXPLICIT f32 accumulation: bf16/f16 operands
+    stream through the MXU, the accumulator is pinned to f32 via
+    ``preferred_element_type``, and the result is rounded back to the
+    activation dtype — the production AMP conv contract, stated in the HLO
+    instead of left to backend defaults.
+
+    The custom VJP exists because ``preferred_element_type`` breaks jax's
+    conv transpose rules under autodiff (the rhs rule feeds the f32
+    cotangent into a conv against bf16 primals and lax rejects the mixed
+    dtypes). The backward therefore differentiates the PLAIN low-precision
+    conv — its cotangents are already in the activation dtype, and the two
+    backward convs get the same implicit f32 accumulation from XLA:TPU.
+    """
+    out = jax.lax.conv_general_dilated(
+        a, w, window_strides=stride, padding=pad,
+        lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    return out.astype(a.dtype)
+
+
+def _conv_accum_f32_fwd(a, w, stride, pad, lhs_dilation, rhs_dilation, dn,
+                        groups):
+    out = _conv_accum_f32(a, w, stride, pad, lhs_dilation, rhs_dilation,
+                          dn, groups)
+    return out, (a, w)
+
+
+def _conv_accum_f32_bwd(stride, pad, lhs_dilation, rhs_dilation, dn, groups,
+                        res, g):
+    a, w = res
+
+    def plain(a_, w_):
+        return jax.lax.conv_general_dilated(
+            a_, w_, window_strides=stride, padding=pad,
+            lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    _, vjp = jax.vjp(plain, a, w)
+    return vjp(g.astype(a.dtype))
+
+
+_conv_accum_f32.defvjp(_conv_accum_f32_fwd, _conv_accum_f32_bwd)
+
+
+def _run_conv(a, w, stride, pad, lhs_dilation, rhs_dilation, dn, groups):
+    """Dispatch one conv: explicit-f32-accumulation path for the bf16/f16
+    activation stream (AMP), plain conv for full precision."""
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return _conv_accum_f32(a, w.astype(a.dtype), stride, pad,
+                               lhs_dilation, rhs_dilation, dn, groups)
+    return jax.lax.conv_general_dilated(
+        a, w, window_strides=stride, padding=pad,
+        lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+def _conv_specs(n, channel_last):
+    if channel_last:
+        return {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
+                3: ("NDHWC", "OIDHW", "NDHWC")}[n]
+    return {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+
+
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n):
     """Shared conv implementation over lax.conv_general_dilated."""
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    if channel_last:
-        spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
-                3: ("NDHWC", "OIDHW", "NDHWC")}[n]
-    else:
-        spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
-                3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+    x = _t(x)
+    # channels-last planner (nn.layout): inside an active scope a 2-D NCHW
+    # conv runs NHWC-native — the first conv in the chain pays the ONE
+    # entry transpose, everything downstream consumes the tag
+    internal_cl = (n == 2 and not channel_last and _layout.is_active())
+    if internal_cl:
+        if x._layout != "NHWC":
+            x = _layout.to_channels_last(x)
+        channel_last = True
+    spec = _conv_specs(n, channel_last)
 
     if isinstance(padding, str):
         pad = padding.upper()  # 'SAME' | 'VALID'
@@ -249,29 +327,26 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n)
         p = _norm_tuple(padding, n) if not (isinstance(padding, (list, tuple)) and
                                             isinstance(padding[0], (list, tuple))) else padding
         if isinstance(p[0], (list, tuple)):
-            pad = [tuple(pp) for pp in p]
+            pad = tuple(tuple(pp) for pp in p)
         else:
-            pad = [(pi, pi) for pi in p]
+            pad = tuple((pi, pi) for pi in p)
 
     def _conv(a, w, *maybe_bias):
-        # NOTE: no preferred_element_type here — XLA:TPU already
-        # accumulates bf16 convs in f32 internally, and requesting an f32
-        # OUTPUT breaks jax's conv transpose rule under autocast (mixed
-        # bf16 primal / f32 cotangent in the rhs rule)
-        out = jax.lax.conv_general_dilated(
-            a, w, window_strides=stride, padding=pad,
-            rhs_dilation=dilation, dimension_numbers=spec,
-            feature_group_count=groups,
-        )
+        out = _run_conv(a, w, stride, pad, None, dilation, spec, groups)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim
             shape[-1 if channel_last else 1] = b.size
-            out = out + b.reshape(shape)
+            out = out + b.reshape(shape).astype(out.dtype)
         return out
 
-    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
-    return apply(_conv, *args, name=f"conv{n}d")
+    args = (x, _t(weight)) + ((_t(bias),) if bias is not None else ())
+    out = apply(_conv, *args, name=f"conv{n}d",
+                _cache_token=("conv", n, stride, pad, dilation, groups,
+                              spec))
+    if internal_cl:
+        _layout.tag(out)
+    return out
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -302,12 +377,7 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     pads_in = _norm_tuple(padding, n) if not isinstance(padding, str) else None
     opad = _norm_tuple(output_padding, n) if output_padding else (0,) * n
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    if channel_last:
-        spec = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"),
-                3: ("NDHWC", "OIDHW", "NDHWC")}[n]
-    else:
-        spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
-                3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+    spec = _conv_specs(n, channel_last)
 
     def _convt(a, w, *maybe_bias):
         # w layout: [in_c, out_c/groups, *k] (reference conv_transpose layout)
@@ -331,23 +401,23 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
             hi = k_eff - 1 - p_eff[i] + opad[i]
             conv_pads.append((lo, hi))
 
-        out = jax.lax.conv_general_dilated(
-            a, w_, window_strides=(1,) * n, padding=conv_pads,
-            lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=spec, feature_group_count=g,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
-        )
-        if out.dtype != a.dtype:
-            out = out.astype(a.dtype)
+        # same explicit-f32-accumulation contract as the forward conv —
+        # and, via _conv_accum_f32's custom VJP, a backward that actually
+        # differentiates under the bf16 activation stream (the raw
+        # preferred_element_type form broke the conv transpose rule)
+        out = _run_conv(a, w_, (1,) * n, tuple(conv_pads), stride, dilation,
+                        spec, g)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim
             shape[-1 if channel_last else 1] = b.size
-            out = out + b.reshape(shape)
+            out = out + b.reshape(shape).astype(out.dtype)
         return out
 
     args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
-    return apply(_convt, *args, name=f"conv{n}d_transpose")
+    return apply(_convt, *args, name=f"conv{n}d_transpose",
+                 _cache_token=("convt", n, stride, dilation, pads_in, opad,
+                               groups, spec))
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
@@ -377,6 +447,13 @@ def _pool_nd(x, kernel_size, stride, padding, n, reducer, init, data_format,
     ks = _norm_tuple(kernel_size, n)
     st = _norm_tuple(stride if stride is not None else kernel_size, n)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    x = _t(x)
+    # consume the channels-last planner tag: the pool runs NHWC-native
+    # with no transpose on either side
+    internal_cl = (n == 2 and not channel_last and _layout.is_active()
+                   and x._layout == "NHWC")
+    if internal_cl:
+        channel_last = True
     if isinstance(padding, str):
         pad_mode = padding.upper()
         pads = None
@@ -414,7 +491,13 @@ def _pool_nd(x, kernel_size, stride, padding, n, reducer, init, data_format,
         counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding_cfg)
         return (summed / counts).astype(a.dtype)
 
-    return apply(_pool, _t(x), name=f"{reducer}_pool{n}d")
+    out = apply(_pool, x, name=f"{reducer}_pool{n}d",
+                _cache_token=("pool", n, ks, st, pad_mode, pads, reducer,
+                              channel_last, count_include_pad,
+                              divisor_override))
+    if internal_cl:
+        _layout.tag(out)
+    return out
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -458,9 +541,14 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def _adaptive_pool(x, output_size, n, mode, data_format):
     out_sizes = _norm_tuple(output_size, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    x = _t(x)
+    internal_cl = (n == 2 and not channel_last and _layout.is_active()
+                   and x._layout == "NHWC")
+    if internal_cl:
+        channel_last = True
 
     def _ap(a):
-        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
         spatial0 = 1 if channel_last else 2
         out = a
         for i, osz in enumerate(out_sizes):
@@ -486,7 +574,11 @@ def _adaptive_pool(x, output_size, n, mode, data_format):
                 out = jnp.concatenate(slices, axis=ax)
         return out
 
-    return apply(_ap, _t(x), name=f"adaptive_{mode}_pool{n}d")
+    out = apply(_ap, x, name=f"adaptive_{mode}_pool{n}d",
+                _cache_token=("apool", n, out_sizes, mode, channel_last))
+    if internal_cl:
+        _layout.tag(out)
+    return out
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -662,6 +754,20 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 # Normalization
 # ---------------------------------------------------------------------------
 
+def _bn_fold_scale_shift(mean, var, gamma, beta, epsilon):
+    """Fold BN statistics (+optional affine) into one (scale, shift) pair,
+    computed in f32 — shared by batch_norm and fused_conv_bn so the folded
+    math can never diverge between the fused and unfused paths."""
+    inv = jax.lax.rsqrt(var + epsilon)
+    if gamma is not None:
+        scale = gamma.astype(jnp.float32) * inv
+        shift = beta.astype(jnp.float32) - mean * scale
+    else:
+        scale = inv
+        shift = -mean * inv
+    return scale, shift
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
                use_global_stats=None, name=None):
@@ -673,6 +779,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     """
     x = _t(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    # channels-last planner tag: normalize over the NHWC channel axis
+    # without leaving the internal layout
+    internal_cl = (not channel_last and _layout.is_active()
+                   and x._layout == "NHWC")
+    if internal_cl:
+        channel_last = True
     ch_axis = x.ndim - 1 if channel_last else 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     use_batch_stats = training and not use_global_stats
@@ -685,14 +797,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     # form round-tripped every conv output through f32, which the
     # ResNet-50 trace showed as ~40 ms/step of pure convert/copy traffic.
     def _bn_apply(a, mean, var, wb):
-        inv = jax.lax.rsqrt(var + epsilon)
-        if wb:
-            w, b = wb
-            scale = w.astype(jnp.float32) * inv
-            shift = b.astype(jnp.float32) - mean * scale
-        else:
-            scale = inv
-            shift = -mean * inv
+        scale, shift = _bn_fold_scale_shift(
+            mean, var, wb[0] if wb else None, wb[1] if wb else None,
+            epsilon)
         shape = [1] * a.ndim
         shape[ch_axis] = a.shape[ch_axis]
         return a * scale.reshape(shape).astype(a.dtype) \
@@ -715,19 +822,151 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         args = [x, _t(running_mean), _t(running_var)]
         if weight is not None:
             args += [_t(weight), _t(bias)]
-        out, new_rm, new_rv = apply(_bn_train, *args, name="batch_norm")
+        out, new_rm, new_rv = apply(
+            _bn_train, *args, name="batch_norm",
+            _cache_token=("bn_train", ch_axis, reduce_axes, momentum,
+                          epsilon))
         # in-place update of running stats (buffers); recorded as replayable
         # write events when a static Program is being built, with the eval
         # normalization as the clone(for_test=True) twin
         annotate_test_variant(_bn_eval)
         record_mutation(running_mean, new_rm)
         record_mutation(running_var, new_rv)
+        if internal_cl:
+            _layout.tag(out)
         return out
 
     args = [x, _t(running_mean), _t(running_var)]
     if weight is not None:
         args += [_t(weight), _t(bias)]
-    return apply(_bn_eval, *args, name="batch_norm")
+    out = apply(_bn_eval, *args, name="batch_norm",
+                _cache_token=("bn_eval", ch_axis, epsilon))
+    if internal_cl:
+        _layout.tag(out)
+    return out
+
+
+def fused_conv_bn(x, weight, bias, running_mean, running_var, bn_weight,
+                  bn_bias, stride=1, padding=0, dilation=1, groups=1,
+                  data_format="NCHW", training=False, momentum=0.9,
+                  epsilon=1e-5, activation=None, use_global_stats=None,
+                  name=None):
+    """Conv2D → BatchNorm → activation as ONE op: the vision fast path's
+    epilogue fusion.
+
+    Training: the conv runs on the bf16 activation stream (AMP policy
+    resolved here, since the generic dispatch cast must not touch the f32
+    EMA buffers), batch statistics accumulate in f32, and the folded
+    scale/shift + activation land in the conv's XLA epilogue — one kernel
+    region and ONE eager tape node instead of three. Running-stat EMA
+    buffers stay f32 under every AMP level (the op is on the AMP
+    keep-dtype list, mirroring batch_norm).
+
+    Inference deployments fold the BN entirely into the conv weights
+    instead — see paddle_tpu.inference.passes.fold_conv_bn.
+
+    ``activation``: None | "relu" | "relu6".
+    """
+    if activation not in (None, "relu", "relu6"):
+        raise ValueError(f"fused_conv_bn supports relu/relu6, got "
+                         f"{activation!r}")
+    n = 2
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    channel_last = data_format == "NHWC"
+    x = _t(x)
+    internal_cl = (not channel_last and _layout.is_active())
+    if internal_cl:
+        if x._layout != "NHWC":
+            x = _layout.to_channels_last(x)
+        channel_last = True
+    spec = _conv_specs(n, channel_last)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, n)
+        pad = tuple((pi, pi) for pi in p)
+    ch_axis = x.ndim - 1 if channel_last else 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    # the conv's AMP cast target, resolved HERE: the op itself is
+    # keep-dtype (a blanket input cast would round the f32 EMA buffers
+    # through bf16), so the bf16 stream is applied to the conv operands
+    # only, inside the op
+    from ..core import tensor as _core_tensor
+    amp_dt = (_core_tensor._amp_target_hook("conv2d")
+              if _core_tensor._amp_target_hook is not None else None)
+    act_fn = {None: None, "relu": jax.nn.relu, "relu6": jax.nn.relu6}[activation]
+    has_cb = bias is not None
+    has_affine = bn_weight is not None
+
+    def _conv_part(a, w, cb):
+        if amp_dt is not None:
+            td = jnp.dtype(amp_dt)
+            a = a.astype(td) if a.dtype != td else a
+            w = w.astype(td) if w.dtype != td else w
+        out = _run_conv(a, w, stride, pad, None, dilation, spec, groups)
+        if cb is not None:
+            shape = [1] * out.ndim
+            shape[ch_axis] = cb.size
+            out = out + cb.reshape(shape).astype(out.dtype)
+        return out
+
+    def _bn_part(out, mean, var, gamma, beta):
+        scale, shift = _bn_fold_scale_shift(mean, var, gamma, beta, epsilon)
+        shape = [1] * out.ndim
+        shape[ch_axis] = out.shape[ch_axis]
+        y = out * scale.reshape(shape).astype(out.dtype) \
+            + shift.reshape(shape).astype(out.dtype)
+        return act_fn(y) if act_fn is not None else y
+
+    def _split_rest(rest):
+        i = 0
+        cb = gamma = beta = None
+        if has_cb:
+            cb = rest[i]; i += 1
+        if has_affine:
+            gamma, beta = rest[i], rest[i + 1]
+        return cb, gamma, beta
+
+    def _fcb_eval(a, w, rm, rv, *rest):
+        cb, gamma, beta = _split_rest(rest)
+        out = _conv_part(a, w, cb)
+        return _bn_part(out, rm.astype(jnp.float32),
+                        rv.astype(jnp.float32), gamma, beta)
+
+    args = [x, _t(weight), _t(running_mean), _t(running_var)]
+    if has_cb:
+        args.append(_t(bias))
+    if has_affine:
+        args += [_t(bn_weight), _t(bn_bias)]
+    token_tail = (stride, pad, dilation, groups, spec, ch_axis, momentum,
+                  epsilon, activation, amp_dt, has_cb, has_affine)
+
+    if training and not use_global_stats:
+        def _fcb_train(a, w, rm, rv, *rest):
+            cb, gamma, beta = _split_rest(rest)
+            out = _conv_part(a, w, cb)
+            out32 = out.astype(jnp.float32)
+            mean = jnp.mean(out32, axis=reduce_axes)
+            var = jnp.var(out32, axis=reduce_axes)
+            y = _bn_part(out, mean, var, gamma, beta)
+            new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
+            new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
+            return y, new_rm, new_rv
+
+        out, new_rm, new_rv = apply(
+            _fcb_train, *args, name="fused_conv_bn",
+            _cache_token=("fcb_train",) + token_tail)
+        annotate_test_variant(_fcb_eval)
+        record_mutation(running_mean, new_rm)
+        record_mutation(running_var, new_rv)
+    else:
+        out = apply(_fcb_eval, *args, name="fused_conv_bn",
+                    _cache_token=("fcb_eval",) + token_tail)
+    if internal_cl:
+        _layout.tag(out)
+    return out
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
@@ -982,7 +1221,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         return _reduce(loss, reduction)
 
     args = [_t(input), _t(label)] + ([w] if w is not None else [])
-    return apply(_ce, *args, name="cross_entropy")
+    return apply(_ce, *args, name="cross_entropy",
+                 _cache_token=("ce", reduction, axis, ignore_index,
+                               bool(soft_label), bool(use_softmax),
+                               float(label_smoothing)))
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,

@@ -16,6 +16,7 @@ from ..layer import Layer
 __all__ = [
     "Conv1D", "Conv2D", "Conv3D",
     "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+    "fused_conv_bn_act",
 ]
 
 
@@ -59,6 +60,37 @@ class _ConvNd(Layer):
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
                 f"kernel_size={self._kernel_size}, stride={self._stride}")
+
+
+def fused_conv_bn_act(conv, bn, x, activation=None):
+    """Run a (Conv2D, BatchNorm2D) layer pair (+optional relu/relu6) as ONE
+    fused op — the vision models' conv→BN→act fast path.
+
+    Falls back to the plain three-op composition when the fusion flag is
+    off or when either layer is not the stock class (quant-wrapped convs,
+    BN already folded to Identity by the inference pass, ...), so callers
+    can use it unconditionally. Parameter/buffer naming is untouched —
+    this reads the layers' existing state, it does not restructure them.
+    """
+    from ...core.flags import get_flag
+    from .norm import SyncBatchNorm, _BatchNormBase
+
+    if get_flag("fused_conv_bn") and type(conv) is Conv2D \
+            and isinstance(bn, _BatchNormBase) \
+            and not isinstance(bn, SyncBatchNorm):
+        return F.fused_conv_bn(
+            x, conv.weight, conv.bias, bn._mean, bn._variance, bn.weight,
+            bn.bias, stride=conv._stride, padding=conv._padding,
+            dilation=conv._dilation, groups=conv._groups,
+            data_format=conv._data_format, training=bn.training,
+            momentum=bn._momentum, epsilon=bn._epsilon,
+            activation=activation, use_global_stats=bn._use_global_stats)
+    out = bn(conv(x))
+    if activation == "relu":
+        out = F.relu(out)
+    elif activation == "relu6":
+        out = F.relu6(out)
+    return out
 
 
 class Conv1D(_ConvNd):

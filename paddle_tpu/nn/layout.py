@@ -1,0 +1,189 @@
+"""Internal-layout planner: channels-last persistence for vision stacks.
+
+TPU MXU convolutions are NHWC-native; the public API contract of this
+framework (like the reference's) is NCHW. Before this module, every
+conv/pool/BN in an NCHW model carried NCHW dimension numbers into XLA and
+paid per-op layout churn. The planner instead runs whole
+conv/BN/activation/pool chains channels-last END TO END:
+
+- a thread-local :func:`channels_last_scope` marks a region (a vision
+  model's feature extractor, or a whole jitted train step — see
+  ``TrainStep`` and ``FLAGS_jit_channels_last``);
+- the FIRST conv2d inside the scope transposes its NCHW input to NHWC
+  once (``layout_entry``) and tags the output tensor (``Tensor._layout ==
+  "NHWC"``);
+- layout-AWARE ops (conv2d, batch_norm, the 2-D pools, fused_conv_bn)
+  consume the tag natively — they run with channels-last dimension
+  numbers / channel axis and re-tag their outputs;
+- layout-TRANSPARENT ops (elementwise activations, add/mul, dropout, ...)
+  propagate the tag through ``apply`` without touching data;
+- the first layout-UNAWARE op (flatten, reshape, matmul, ...) gets a
+  single ``layout_exit`` transpose back to NCHW inserted in front of it.
+
+Net effect: one transpose at model entry, one at exit, NHWC convs in
+between — while the user-facing NCHW API contract is unchanged (see
+docs/PARITY.md, internal-layout contract).
+
+The hooks are installed into ``core.tensor.apply`` at import and are
+no-ops (one thread-local read) unless a scope is active on the calling
+thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, set_layout_hooks
+
+__all__ = ["channels_last_scope", "check_data_format", "is_active",
+           "layout_of", "to_channels_last", "to_channels_first"]
+
+
+def check_data_format(data_format: str) -> str:
+    """Validate a vision model's 2-D data_format flag (shared by the whole
+    model zoo)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            f"data_format must be 'NCHW' or 'NHWC', got {data_format!r}")
+    return data_format
+
+_tls = threading.local()
+
+
+def is_active() -> bool:
+    return getattr(_tls, "active", 0) > 0
+
+
+@contextlib.contextmanager
+def channels_last_scope(enable: bool = True):
+    """Activate the channels-last planner for ops issued inside the block.
+
+    Reentrant; ``enable=False`` is a no-op block so call sites can make
+    the fast path conditional without branching.
+    """
+    if not enable:
+        yield
+        return
+    _tls.active = getattr(_tls, "active", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.active -= 1
+
+
+def layout_of(t) -> str:
+    return getattr(t, "_layout", None) or "NCHW"
+
+
+def tag(t: Tensor) -> Tensor:
+    t._layout = "NHWC"
+    return t
+
+
+# closure-free module-level transposes: eligible for the eager op cache
+def _nchw_to_nhwc(a):
+    return jnp.transpose(a, (0, 2, 3, 1))
+
+
+def _nhwc_to_nchw(a):
+    return jnp.transpose(a, (0, 3, 1, 2))
+
+
+def to_channels_last(t: Tensor) -> Tensor:
+    """The single entry transpose: NCHW tensor -> tagged NHWC tensor."""
+    return tag(apply(_nchw_to_nhwc, t, name="layout_entry"))
+
+
+def to_channels_first(t: Tensor) -> Tensor:
+    """The single exit transpose: tagged NHWC tensor -> NCHW tensor."""
+    out = apply(_nhwc_to_nchw, t, name="layout_exit")
+    out._layout = None
+    return out
+
+
+def ensure_channels_first(t):
+    """Model-boundary guard: restore NCHW if ``t`` is still tagged. Vision
+    model forwards call this on their return value so a headless/unpooled
+    configuration never leaks the internal NHWC layout to the caller."""
+    if isinstance(t, Tensor) and getattr(t, "_layout", None) == "NHWC":
+        return to_channels_first(t)
+    return t
+
+
+# Ops that handle the NHWC tag themselves (consume + re-tag); the pre-hook
+# must not rewrite their inputs. layout_entry/exit are here so the hook
+# never recurses into its own transposes.
+_AWARE = frozenset({
+    "conv2d", "fused_conv_bn", "batch_norm",
+    "max_pool2d", "avg_pool2d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "layout_entry", "layout_exit",
+})
+
+# Elementwise ops that preserve shape and therefore layout: the tag rides
+# through them untouched (post-hook re-tags the output when its shape
+# matches the tagged input's). Anything NOT listed here or in _AWARE gets
+# the exit transpose — correctness never depends on this list being
+# complete, only the persistence distance does.
+_TRANSPARENT = frozenset({
+    "relu", "relu6", "leaky_relu", "elu", "selu", "celu", "gelu",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "silu", "swish", "mish", "softplus",
+    "softsign", "tanh", "log_sigmoid",
+    "add", "subtract", "multiply", "divide", "scale", "clip",
+    "maximum", "minimum", "pow", "abs", "neg", "sqrt", "square", "exp",
+    "dropout", "alpha_dropout",
+})
+
+
+def _exit_tagged(args):
+    return tuple(
+        to_channels_first(a)
+        if isinstance(a, Tensor) and getattr(a, "_layout", None) == "NHWC"
+        else a
+        for a in args)
+
+
+def _pre(name: str, args):
+    """apply() pre-hook: insert the exit transpose in front of a
+    layout-unaware op consuming a tagged tensor, and in front of a
+    transparent op whose operands MIX layouts."""
+    if not is_active() or name in _AWARE:
+        return args
+    if not any(isinstance(a, Tensor)
+               and getattr(a, "_layout", None) == "NHWC" for a in args):
+        return args
+    if name in _TRANSPARENT:
+        # Mixed-layout guard: a transparent elementwise op may combine a
+        # tagged (physically NHWC) tensor only with python scalars, 0-d
+        # tensors, or other tagged tensors — an untagged tensor operand
+        # with axes is NCHW-world data whose broadcast would silently bind
+        # to permuted axes (even 1-D: trailing-axis broadcast means W in
+        # NCHW but C in NHWC). Fall back to NCHW for this op instead.
+        mixed = any(
+            isinstance(a, Tensor)
+            and getattr(a, "_layout", None) != "NHWC"
+            and a._data.ndim >= 1
+            for a in args)
+        if not mixed:
+            return args
+    return _exit_tagged(args)
+
+
+def _post(name: str, args, result):
+    """apply() post-hook: propagate the tag through transparent ops."""
+    if not is_active() or name not in _TRANSPARENT:
+        return
+    if not isinstance(result, Tensor) or result._data.ndim != 4:
+        return
+    for a in args:
+        if isinstance(a, Tensor) \
+                and getattr(a, "_layout", None) == "NHWC" \
+                and a._data.shape == result._data.shape:
+            result._layout = "NHWC"
+            return
+
+
+set_layout_hooks(_pre, _post)

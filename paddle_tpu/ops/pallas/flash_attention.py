@@ -45,6 +45,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 names the dataclass TPUCompilerParams; same fields
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 # 512x512 tiles win on v5e: fewer grid steps amortize the VMEM loads and the
 # p-tile (512*512*4B = 1 MiB) still fits comfortably; measured ~28% faster
 # than 128x128 at S=2048 and ahead of XLA's fused sdpa.
@@ -886,6 +890,27 @@ def _flash_bwd(scale, causal, block_q, block_k, rate, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(var: str, default: int) -> int:
+    """Validated block-size override from the environment; the error names
+    the env var so a bad value is traceable to its source (a bare int()
+    ValueError at every flash call gave no hint an env var was the cause)."""
+    import os
+    raw = os.environ.get(var)
+    if not raw:
+        return default
+    try:
+        b = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var}={raw!r}: the flash-attention block override must be an "
+            f"integer number of rows (a multiple of 128)") from None
+    if b <= 0 or b % 128:
+        raise ValueError(
+            f"{var}={b}: the flash-attention block override must be a "
+            f"positive multiple of 128 (the TPU lane tile)")
+    return b
+
+
 def _pick_block(seq_len: int, requested: int) -> int:
     """Largest multiple of 128 that divides seq_len, capped at `requested`
     (so 768 -> 384 with the 512 default rather than failing)."""
@@ -916,14 +941,13 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     # tuning override without touching call sites (block sweeps on real
-    # hardware; see docs/PERF_GPT.md)
-    import os
-    env_q = os.environ.get("PTPU_FLASH_BLOCK_Q")
-    env_k = os.environ.get("PTPU_FLASH_BLOCK_K")
-    if env_q:
-        block_q = int(env_q)
-    if env_k:
-        block_k = int(env_k)
+    # hardware; see docs/PERF_GPT.md). Only applied when the caller left
+    # the block size at its default — an explicit block_q/block_k argument
+    # always wins over the environment.
+    if block_q == DEFAULT_BLOCK:
+        block_q = _env_block("PTPU_FLASH_BLOCK_Q", block_q)
+    if block_k == DEFAULT_BLOCK:
+        block_k = _env_block("PTPU_FLASH_BLOCK_K", block_k)
     block_q = _pick_block(Sq, block_q)
     block_k = _pick_block(Sk, block_k)
     if scale is None:

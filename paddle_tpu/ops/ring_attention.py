@@ -39,6 +39,15 @@ __all__ = ["ring_attention", "ulysses_attention", "block_attention"]
 NEG_INF = -1e30
 
 
+def _pcast_varying(x, axis_name):
+    """Mark ``x`` as device-varying over ``axis_name`` for shard_map's VMA
+    type checking (jax >= 0.5). Legacy jax has neither lax.pcast nor VMA
+    typing, where this is correctly a no-op."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return x
+
+
 def block_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
     """Local attention returning (out, lse) for cross-block merging.
@@ -111,7 +120,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         def skip(_):
             z = jnp.full(q.shape[:2] + (q.shape[2],), NEG_INF, jnp.float32)
             return (jnp.zeros_like(q),
-                    lax.pcast(z, (axis_name,), to="varying"))
+                    _pcast_varying(z, axis_name))
 
         if causal:
             rel = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
@@ -128,8 +137,8 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     lse0 = jnp.full(q.shape[:2] + (q.shape[2],), NEG_INF, jnp.float32)
     # mark the constant initial carries as device-varying so the scan carry
     # type matches the per-device outputs under shard_map's vma checking
-    o0 = lax.pcast(o0, (axis_name,), to="varying")
-    lse0 = lax.pcast(lse0, (axis_name,), to="varying")
+    o0 = _pcast_varying(o0, axis_name)
+    lse0 = _pcast_varying(lse0, axis_name)
     (o, lse, _, _, _), _ = lax.scan(step, (o0, lse0, k, v, my), None,
                                     length=n)
     return o.astype(q.dtype)

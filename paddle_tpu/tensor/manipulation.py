@@ -47,7 +47,8 @@ def cast(x, dtype):
 
 def reshape(x, shape, name=None):
     shp = _ints(shape)
-    return apply(lambda a: jnp.reshape(a, shp), _t(x), name="reshape")
+    return apply(lambda a: jnp.reshape(a, shp), _t(x), name="reshape",
+                 _cache_token=("reshape", shp))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -57,12 +58,14 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         e = stop_axis % nd if nd else 0
         new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
         return jnp.reshape(a, new_shape)
-    return apply(_flat, _t(x), name="flatten")
+    return apply(_flat, _t(x), name="flatten",
+                 _cache_token=("flatten", start_axis, stop_axis))
 
 
 def transpose(x, perm, name=None):
     p = _ints(perm)
-    return apply(lambda a: jnp.transpose(a, p), _t(x), name="transpose")
+    return apply(lambda a: jnp.transpose(a, p), _t(x), name="transpose",
+                 _cache_token=("transpose", p))
 
 
 def moveaxis(x, source, destination, name=None):

@@ -1,14 +1,20 @@
-"""LeNet (reference: python/paddle/vision/models/lenet.py)."""
+"""LeNet (reference: python/paddle/vision/models/lenet.py).
+
+``data_format="NHWC"`` runs the tiny conv stack channels-last internally
+(nn.layout planner; public NCHW contract unchanged).
+"""
 
 from __future__ import annotations
 
 from ... import nn
+from ...nn import layout as _layout
 
 
 class LeNet(nn.Layer):
-    def __init__(self, num_classes=10):
+    def __init__(self, num_classes=10, data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
+        self.data_format = _layout.check_data_format(data_format)
         self.features = nn.Sequential(
             nn.Conv2D(1, 6, 3, stride=1, padding=1),
             nn.ReLU(),
@@ -25,9 +31,11 @@ class LeNet(nn.Layer):
             )
 
     def forward(self, inputs):
-        x = self.features(inputs)
-        if self.num_classes > 0:
-            from ...tensor.manipulation import flatten
-            x = flatten(x, 1)
-            x = self.fc(x)
+        with _layout.channels_last_scope(self.data_format == "NHWC"):
+            x = self.features(inputs)
+            if self.num_classes > 0:
+                from ...tensor.manipulation import flatten
+                x = flatten(x, 1)
+                x = self.fc(x)
+            x = _layout.ensure_channels_first(x)
         return x

@@ -1,8 +1,15 @@
-"""MobileNet V1/V2 (reference: python/paddle/vision/models/mobilenetv1.py, v2)."""
+"""MobileNet V1/V2 (reference: python/paddle/vision/models/mobilenetv1.py, v2).
+
+``data_format="NHWC"`` runs the feature extractor channels-last internally
+via the nn.layout planner (one transpose at entry, one at exit — the TPU
+MXU-native conv layout) while the public NCHW contract is unchanged; the
+conv→BN→ReLU6 triples run as single fused ops (nn.fused_conv_bn_act).
+"""
 
 from __future__ import annotations
 
 from ... import nn
+from ...nn import layout as _layout
 
 __all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
 
@@ -17,6 +24,10 @@ class _ConvBNReLU(nn.Sequential):
             nn.ReLU6(),
         )
 
+    def forward(self, x):
+        conv, bn, _ = self._sub_layers.values()
+        return nn.fused_conv_bn_act(conv, bn, x, "relu6")
+
 
 class _DepthwiseSeparable(nn.Layer):
     def __init__(self, in_c, out_c, stride):
@@ -29,10 +40,12 @@ class _DepthwiseSeparable(nn.Layer):
 
 
 class MobileNetV1(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = _layout.check_data_format(data_format)
         s = lambda c: max(int(c * scale), 8)
         cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
                (s(128), s(256), 2), (s(256), s(256), 1), (s(256), s(512), 2)] + \
@@ -48,12 +61,17 @@ class MobileNetV1(nn.Layer):
             self.fc = nn.Linear(s(1024), num_classes)
 
     def forward(self, x):
-        x = self.features(x)
-        if self.with_pool:
-            x = self.pool(x)
-        if self.num_classes > 0:
-            from ...tensor.manipulation import flatten
-            x = self.fc(flatten(x, 1))
+        # NHWC flag: the planner keeps the whole conv stack channels-last;
+        # the pool consumes the tag and flatten restores NCHW order, so the
+        # head sees identical features either way
+        with _layout.channels_last_scope(self.data_format == "NHWC"):
+            x = self.features(x)
+            if self.with_pool:
+                x = self.pool(x)
+            if self.num_classes > 0:
+                from ...tensor.manipulation import flatten
+                x = self.fc(flatten(x, 1))
+            x = _layout.ensure_channels_first(x)
         return x
 
 
@@ -78,10 +96,12 @@ class _InvertedResidual(nn.Layer):
 
 
 class MobileNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = _layout.check_data_format(data_format)
         cfg = [
             (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
@@ -103,12 +123,14 @@ class MobileNetV2(nn.Layer):
                 nn.Dropout(0.2), nn.Linear(last_c, num_classes))
 
     def forward(self, x):
-        x = self.features(x)
-        if self.with_pool:
-            x = self.pool(x)
-        if self.num_classes > 0:
-            from ...tensor.manipulation import flatten
-            x = self.classifier(flatten(x, 1))
+        with _layout.channels_last_scope(self.data_format == "NHWC"):
+            x = self.features(x)
+            if self.with_pool:
+                x = self.pool(x)
+            if self.num_classes > 0:
+                from ...tensor.manipulation import flatten
+                x = self.classifier(flatten(x, 1))
+            x = _layout.ensure_channels_first(x)
         return x
 
 

@@ -37,8 +37,8 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = nn.fused_conv_bn_act(self.conv1, self.bn1, x, "relu")
+        out = nn.fused_conv_bn_act(self.conv2, self.bn2, out, None)
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -70,9 +70,9 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = nn.fused_conv_bn_act(self.conv1, self.bn1, x, "relu")
+        out = nn.fused_conv_bn_act(self.conv2, self.bn2, out, "relu")
+        out = nn.fused_conv_bn_act(self.conv3, self.bn3, out, None)
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -87,9 +87,8 @@ class ResNet(nn.Layer):
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
         }
         layers = layer_cfg[depth]
-        if data_format not in ("NCHW", "NHWC"):
-            raise ValueError(
-                f"data_format must be 'NCHW' or 'NHWC', got {data_format!r}")
+        from ...nn import layout as _layout
+        _layout.check_data_format(data_format)
         self.groups = groups
         self.base_width = width
         self.num_classes = num_classes
@@ -146,7 +145,7 @@ class ResNet(nn.Layer):
             # with spatial dims
             from ...tensor.manipulation import transpose
             x = transpose(x, [0, 2, 3, 1])
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = nn.fused_conv_bn_act(self.conv1, self.bn1, x, "relu")
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

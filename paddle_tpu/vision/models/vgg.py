@@ -1,8 +1,13 @@
-"""VGG (reference: python/paddle/vision/models/vgg.py)."""
+"""VGG (reference: python/paddle/vision/models/vgg.py).
+
+``data_format="NHWC"`` runs the conv/pool stack channels-last internally
+(nn.layout planner; public NCHW contract unchanged).
+"""
 
 from __future__ import annotations
 
 from ... import nn
+from ...nn import layout as _layout
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -32,11 +37,13 @@ def _make_layers(cfg, batch_norm=False):
 
 
 class VGG(nn.Layer):
-    def __init__(self, features, num_classes=1000, with_pool=True):
+    def __init__(self, features, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.features = features
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = _layout.check_data_format(data_format)
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
         if num_classes > 0:
@@ -47,13 +54,15 @@ class VGG(nn.Layer):
             )
 
     def forward(self, x):
-        x = self.features(x)
-        if self.with_pool:
-            x = self.avgpool(x)
-        if self.num_classes > 0:
-            from ...tensor.manipulation import flatten
-            x = flatten(x, 1)
-            x = self.classifier(x)
+        with _layout.channels_last_scope(self.data_format == "NHWC"):
+            x = self.features(x)
+            if self.with_pool:
+                x = self.avgpool(x)
+            if self.num_classes > 0:
+                from ...tensor.manipulation import flatten
+                x = flatten(x, 1)
+                x = self.classifier(x)
+            x = _layout.ensure_channels_first(x)
         return x
 
 

@@ -93,3 +93,28 @@ def test_cli_synthetic_10pct_regression(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "resnet50_imgs_per_sec" in proc.stdout
     assert "gpt_tokens_per_sec" not in proc.stdout
+
+
+def test_compare_common_ignores_skipped_benchmarks():
+    """The in-run self-gate (bench.py) compares only the intersection: a
+    --quick run (BERT only) against a full record logs NO false
+    'disappeared' regressions, but a real regression in a common metric
+    still fires."""
+    full = [_m("bert_tokens_per_sec", 160000.0, "tokens/s"),
+            _m("resnet50_imgs_per_sec", 2200.0, "img/s"),
+            _m("lenet_eager_ms_per_step", 120.0, "ms")]
+    quick_ok = [_m("bert_tokens_per_sec", 158000.0, "tokens/s")]
+    assert check_bench.compare_common(full, quick_ok) == []
+
+    quick_bad = [_m("bert_tokens_per_sec", 120000.0, "tokens/s")]  # -25%
+    problems = check_bench.compare_common(full, quick_bad)
+    assert len(problems) == 1 and "bert_tokens_per_sec" in problems[0]
+    assert not any("disappeared" in p for p in problems)
+
+
+def test_compare_still_flags_disappearance_for_cli_gate():
+    """The CLI cross-record gate keeps the disappearance check."""
+    old = [_m("a", 1.0, "tokens/s"), _m("b", 2.0, "tokens/s")]
+    new = [_m("a", 1.0, "tokens/s")]
+    assert any("disappeared" in p for p in check_bench.compare(old, new))
+    assert check_bench.compare_common(old, new) == []

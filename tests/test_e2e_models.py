@@ -182,3 +182,128 @@ def test_resnet_nhwc_backbone_contract_and_validation():
 
     with pytest.raises(ValueError, match="data_format"):
         resnet18(data_format="nhwc")
+
+
+def test_resnet18_train_step_parity_across_layouts():
+    """ResNet-18 TrainStep losses under the channels-last rewrite
+    (FLAGS_jit_channels_last, the default) match the plain NCHW trace over
+    two optimizer steps — the end-to-end train-path contract."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.optimizer import Momentum
+
+    x_np = np.random.default_rng(0).normal(size=(2, 3, 64, 64)) \
+        .astype(np.float32)
+    y_np = np.arange(2, dtype=np.int64) % 10
+
+    losses = {}
+    for flag in (True, False):
+        paddle.set_flags({"jit_channels_last": flag})
+        try:
+            paddle.seed(0)
+            m = resnet18(num_classes=10)
+            m.train()
+            opt = Momentum(learning_rate=0.005, parameters=m.parameters())
+
+            def loss_fn(layer, xb, yb):
+                return F.cross_entropy(layer(xb), yb)
+
+            step = TrainStep(m, loss_fn, opt)
+            xs = paddle.to_tensor(x_np)
+            ys = paddle.to_tensor(y_np)
+            losses[flag] = [float(step(xs, ys)) for _ in range(2)]
+        finally:
+            paddle.set_flags({"jit_channels_last": True})
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-3)
+
+
+def test_resnet50_fwd_bwd_gradient_parity_across_layouts():
+    """ResNet-50 fwd+bwd: the loss and EVERY parameter gradient under the
+    channels-last planner match the NCHW trace. Tolerance note: per-op and
+    per-block layout parity is ~1e-6 (test_layout.py, bottleneck checks);
+    through 53 stacked batch-norms the f32 reassociation noise is amplified
+    by the stats' conditioning, so the full-model gate is an L2-relative
+    bound per tensor — a real layout bug (wrong axis, wrong transpose)
+    produces O(1) errors, far above it."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.random import trace_rng
+    from paddle_tpu.core.tensor import no_grad
+    from paddle_tpu.jit.functional import bind, buffer_arrays
+    from paddle_tpu.nn import layout
+    from paddle_tpu.vision.models import resnet50
+
+    B = 8
+    x_np = np.random.default_rng(0).normal(size=(B, 3, 64, 64)) \
+        .astype(np.float32)
+    y_np = (np.arange(B) % 10).astype(np.int64)
+    paddle.seed(0)
+    m = resnet50(num_classes=10)
+    m.train()
+    params = {k: p._data for k, p in m.named_parameters()}
+    bufs = buffer_arrays(m)
+
+    def make_loss(cl):
+        def loss(p):
+            b = dict(bufs)
+            with trace_rng(jax.random.key(0)), no_grad(), \
+                    layout.channels_last_scope(cl):
+                with bind(m, p, b):
+                    out = F.cross_entropy(m(paddle.to_tensor(x_np)),
+                                          paddle.to_tensor(y_np))
+            return out._data.astype(jnp.float32), b
+        return loss
+
+    (l_ref, _), g_ref = jax.value_and_grad(make_loss(False),
+                                           has_aux=True)(params)
+    (l_cl, _), g_cl = jax.value_and_grad(make_loss(True),
+                                         has_aux=True)(params)
+    np.testing.assert_allclose(float(l_cl), float(l_ref), rtol=1e-5)
+    for k in g_ref:
+        a, b = np.asarray(g_ref[k]), np.asarray(g_cl[k])
+        rel = np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-12)
+        assert rel < 0.05, f"{k}: grad L2-relative error {rel:.3f}"
+
+
+def test_vgg_mobilenet_nhwc_flag_parity():
+    """The data_format="NHWC" model flag (VGG/MobileNet) preserves the
+    public NCHW contract and the numerics."""
+    from paddle_tpu.vision.models import mobilenet_v2, vgg11
+
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .normal(size=(2, 3, 32, 32)).astype(np.float32))
+    for ctor, kw in ((vgg11, dict(num_classes=0)),
+                     (mobilenet_v2, dict(num_classes=7, scale=0.25))):
+        paddle.seed(0)
+        a = ctor(**kw)
+        paddle.seed(0)
+        b = ctor(data_format="NHWC", **kw)
+        a.eval()
+        b.eval()
+        with paddle.no_grad():
+            np.testing.assert_allclose(a(x).numpy(), b(x).numpy(),
+                                       atol=2e-3, rtol=1e-3)
+        with pytest.raises(ValueError, match="data_format"):
+            ctor(data_format="nhwc", **kw)
+
+
+def test_inference_fold_conv_bn_parity():
+    """The inference conv+BN weight-folding pass preserves eval outputs
+    and removes the BN layers."""
+    from paddle_tpu.inference.passes import fold_conv_bn
+
+    paddle.seed(0)
+    m = resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.default_rng(3)
+                         .normal(size=(2, 3, 64, 64)).astype(np.float32))
+    m.train()
+    with paddle.no_grad():
+        m(x)                               # make EMA stats non-trivial
+    m.eval()
+    with paddle.no_grad():
+        ref = m(x).numpy()
+    folded = fold_conv_bn(m)
+    assert folded == 20                    # resnet18: 16 block + stem + 3 ds
+    with paddle.no_grad():
+        np.testing.assert_allclose(m(x).numpy(), ref, atol=2e-3, rtol=1e-3)

@@ -251,3 +251,37 @@ def test_jit_and_under_trainstep_shapes():
     jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
     out = jitted(q, k, v)
     assert out.shape == (B, S, H, D)
+
+
+def test_env_block_override_validated_and_scoped(monkeypatch):
+    """PTPU_FLASH_BLOCK_Q/K overrides: a bad value raises an error NAMING
+    the env var; a valid override only applies when the caller left the
+    block size at its default (explicit arguments always win)."""
+    import pytest
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    # bad values: named error, raised before any kernel work
+    q = jnp.zeros((1, 128, 1, 8), jnp.float32)
+    monkeypatch.setenv("PTPU_FLASH_BLOCK_Q", "not_a_number")
+    with pytest.raises(ValueError, match="PTPU_FLASH_BLOCK_Q"):
+        fa.flash_attention(q, q, q)
+    monkeypatch.setenv("PTPU_FLASH_BLOCK_Q", "100")     # not a 128 multiple
+    with pytest.raises(ValueError, match="PTPU_FLASH_BLOCK_Q"):
+        fa.flash_attention(q, q, q)
+    monkeypatch.delenv("PTPU_FLASH_BLOCK_Q")
+
+    # precedence: capture what reaches the kernel without running it
+    seen = {}
+
+    def fake_flash(q_, k_, v_, bias, seed_f, scale, causal, bq, bk, rate):
+        seen["bq"], seen["bk"] = bq, bk
+        return q_
+
+    monkeypatch.setattr(fa, "_flash", fake_flash)
+    q = jnp.zeros((1, 512, 1, 8), jnp.float32)
+    monkeypatch.setenv("PTPU_FLASH_BLOCK_Q", "128")
+    fa.flash_attention(q, q, q)                      # default -> env applies
+    assert seen["bq"] == 128
+    fa.flash_attention(q, q, q, block_q=256)         # explicit arg wins
+    assert seen["bq"] == 256

@@ -83,3 +83,45 @@ def test_graph_service_over_tcp():
         c.close()
     finally:
         svc.stop()
+
+
+def test_add_edges_after_load_keeps_loaded_edges(tmp_path):
+    """Regression (ADVICE r5): add_edges() on an edge type restored by
+    load() must ACCUMULATE — the loaded CSR is decomposed back into a
+    pending chunk, not silently dropped by the rebuild."""
+    t = _toy_table()
+    t.save(str(tmp_path))
+    t2 = GraphTable(seed=0)
+    t2.load(str(tmp_path))
+    np.testing.assert_array_equal(t2.degree("follows", [0, 1]), [3, 1])
+
+    t2.add_edges("follows", src=[1, 0], dst=[0, 9])
+    t2.build()
+    # loaded edges survive AND the new ones land
+    np.testing.assert_array_equal(t2.degree("follows", [0, 1, 2]),
+                                  [4, 2, 1])
+    flat, counts = t2.sample_neighbors("follows", [1], sample_size=8)
+    assert counts[0] == 2 and set(flat.tolist()) == {0, 2}
+
+
+def test_wire_codec_roundtrip_and_dtype_allowlist():
+    """The typed struct+numpy wire framing (no pickle): values round-trip
+    exactly; object-dtype buffers are refused in both directions."""
+    import pytest
+
+    from paddle_tpu.distributed.ps.graph import (_pack_fields,
+                                                 _pack_value,
+                                                 _unpack_fields)
+
+    fields = {"op": "sample_neighbors", "edge_type": "follows",
+              "ids": np.asarray([1, 2, 3], np.int64), "sample_size": 5,
+              "replace": False, "none_v": None, "f": 2.5,
+              "lst": [1, 2.0, "x"]}
+    out = _unpack_fields(_pack_fields(fields))
+    assert out["op"] == "sample_neighbors" and out["sample_size"] == 5
+    assert out["replace"] is False and out["none_v"] is None
+    assert out["f"] == 2.5 and out["lst"] == [1, 2.0, "x"]
+    np.testing.assert_array_equal(out["ids"], [1, 2, 3])
+
+    with pytest.raises(TypeError, match="dtype"):
+        _pack_value(np.asarray([object()], dtype=object))

@@ -1,0 +1,214 @@
+"""NHWC-native vision fast path (nn.layout planner + fused conv/BN).
+
+Covers the internal-layout contract of docs/PARITY.md: inside a
+channels-last scope, NCHW conv/BN/pool chains run physically NHWC with
+one entry and one exit transpose, and every public-facing numeric result
+matches the plain NCHW path to fp32 tolerance — fwd AND bwd.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import layout
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_conv_bn_pool_chain_parity_fwd_bwd():
+    """conv2d -> batch_norm -> relu -> max_pool2d chain: channels-last
+    scope matches NCHW numerics and gradients."""
+    x_np = _rand((2, 3, 16, 16))
+    w_np = _rand((8, 3, 3, 3), 1) * 0.2
+    rm = Tensor(np.zeros(8, np.float32))
+    rv = Tensor(np.ones(8, np.float32))
+    g = Tensor(np.full(8, 1.5, np.float32))
+    b = Tensor(np.full(8, 0.25, np.float32))
+
+    def run(channels_last):
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        w = paddle.to_tensor(w_np, stop_gradient=False)
+        rm_ = Tensor(rm._data)
+        rv_ = Tensor(rv._data)
+        with layout.channels_last_scope(channels_last):
+            y = F.conv2d(x, w, stride=1, padding=1)
+            y = F.batch_norm(y, rm_, rv_, g, b, training=True)
+            y = F.relu(y)
+            y = F.max_pool2d(y, 2, 2)
+            loss = y.astype("float32").sum()
+        loss.backward()
+        return (float(loss), x.grad.numpy(), w.grad.numpy(),
+                np.asarray(rm_._data))
+
+    l_ref, gx_ref, gw_ref, rm_ref = run(False)
+    l_cl, gx_cl, gw_cl, rm_cl = run(True)
+    np.testing.assert_allclose(l_cl, l_ref, rtol=1e-5)
+    np.testing.assert_allclose(gx_cl, gx_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(gw_cl, gw_ref, atol=1e-5, rtol=1e-5)
+    # running-stat EMA must update identically (layout-invariant stats)
+    np.testing.assert_allclose(rm_cl, rm_ref, atol=1e-6)
+
+
+def test_scope_tags_and_single_exit():
+    """The planner inserts ONE entry transpose, keeps the tag through
+    transparent ops, and exits exactly at the first layout-unaware op."""
+    x = paddle.to_tensor(_rand((2, 3, 8, 8)))
+    w = paddle.to_tensor(_rand((4, 3, 3, 3), 1))
+    with layout.channels_last_scope():
+        y = F.conv2d(x, w, padding=1)
+        assert y._layout == "NHWC" and y.shape == [2, 8, 8, 4]
+        z = F.relu(y) * 2.0
+        assert z._layout == "NHWC"          # transparent ops keep the tag
+        p = F.avg_pool2d(z, 2, 2)
+        assert p._layout == "NHWC" and p.shape == [2, 4, 4, 4]
+        from paddle_tpu.tensor.manipulation import flatten
+        f = flatten(p, 1)                   # unaware -> exit transpose
+        assert f.shape == [2, 64]
+    # outside any scope nothing is tagged
+    y2 = F.conv2d(x, w, padding=1)
+    assert y2._layout is None and y2.shape == [2, 4, 8, 8]
+
+
+def test_adaptive_pool_and_global_head_parity():
+    """ResNet-style tail: adaptive pool to (1,1) then flatten+linear gives
+    identical logits across layouts (the exit restores NCHW order)."""
+    x_np = _rand((2, 6, 8, 8))
+    w_np = _rand((6 * 1 * 1, 5), 3)
+
+    def run(cl):
+        x = paddle.to_tensor(x_np)
+        lw = paddle.to_tensor(w_np)
+        with layout.channels_last_scope(cl):
+            if cl:   # force a tagged tensor through an identity conv-free path
+                x2 = layout.to_channels_last(x)
+            else:
+                x2 = x
+            p = F.adaptive_avg_pool2d(x2, (1, 1))
+            from paddle_tpu.tensor.manipulation import flatten
+            return F.linear(flatten(p, 1), lw).numpy()
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-6)
+
+
+def test_fused_conv_bn_matches_unfused_train_and_eval():
+    """fused_conv_bn == conv2d + batch_norm + relu, including the EMA
+    buffer updates, in train and eval mode."""
+    x = paddle.to_tensor(_rand((2, 3, 12, 12)), stop_gradient=False)
+    w = paddle.to_tensor(_rand((8, 3, 3, 3), 1) * 0.2, stop_gradient=False)
+    g = Tensor(np.full(8, 1.25, np.float32))
+    b = Tensor(np.full(8, -0.1, np.float32))
+
+    for training in (True, False):
+        rm_f = Tensor(np.zeros(8, np.float32))
+        rv_f = Tensor(np.ones(8, np.float32))
+        rm_u = Tensor(np.zeros(8, np.float32))
+        rv_u = Tensor(np.ones(8, np.float32))
+        fused = F.fused_conv_bn(x, w, None, rm_f, rv_f, g, b, stride=1,
+                                padding=1, training=training,
+                                activation="relu")
+        ref = F.relu(F.batch_norm(F.conv2d(x, w, padding=1), rm_u, rv_u,
+                                  g, b, training=training))
+        np.testing.assert_allclose(fused.numpy(), ref.numpy(), atol=1e-5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rm_f._data),
+                                   np.asarray(rm_u._data), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rv_f._data),
+                                   np.asarray(rv_u._data), atol=1e-6)
+
+    # gradients flow through the fused op
+    loss = F.fused_conv_bn(x, w, None, Tensor(np.zeros(8, np.float32)),
+                           Tensor(np.ones(8, np.float32)), g, b, padding=1,
+                           training=True, activation="relu").sum()
+    loss.backward()
+    assert x.grad is not None and w.grad is not None
+
+
+def test_fused_conv_bn_rejects_unknown_activation():
+    x = paddle.to_tensor(_rand((1, 3, 8, 8)))
+    w = paddle.to_tensor(_rand((4, 3, 3, 3)))
+    with pytest.raises(ValueError, match="relu"):
+        F.fused_conv_bn(x, w, None, Tensor(np.zeros(4, np.float32)),
+                        Tensor(np.ones(4, np.float32)), None, None,
+                        activation="gelu")
+
+
+def test_bf16_conv_explicit_f32_accumulation_grads():
+    """The bf16 conv stream (preferred_element_type=f32 fwd) must be
+    differentiable — the raw form breaks jax's conv transpose rule; the
+    custom VJP restores it. Output and grads stay bf16."""
+    import jax.numpy as jnp
+    x = Tensor(np.ones((2, 3, 8, 8), np.float32))
+    x = Tensor(x._data.astype(jnp.bfloat16))
+    x.stop_gradient = False
+    w = Tensor(_rand((4, 3, 3, 3), 2).astype(np.float32))
+    w = Tensor(w._data.astype(jnp.bfloat16))
+    w.stop_gradient = False
+
+    y = F.conv2d(x, w, padding=1)
+    assert y.dtype == jnp.bfloat16
+    y.astype("float32").sum().backward()
+    assert w.grad is not None and w.grad.dtype == jnp.bfloat16
+
+    # transpose conv: previously broke under grad with bf16 inputs
+    wt = Tensor(_rand((3, 4, 3, 3), 3).astype(np.float32))
+    wt = Tensor(wt._data.astype(jnp.bfloat16))
+    wt.stop_gradient = False
+    yt = F.conv2d_transpose(x, wt, stride=2, padding=1)
+    assert yt.dtype == jnp.bfloat16
+    yt.astype("float32").sum().backward()
+    assert wt.grad is not None
+
+
+def test_amp_o1_conv_bn_chain_under_scope():
+    """AMP O1 + channels-last scope: conv runs bf16 with f32 accumulation,
+    batch_norm keeps its f32 EMA buffers (keep-dtype op)."""
+    import jax.numpy as jnp
+    x = paddle.to_tensor(_rand((2, 3, 8, 8)), stop_gradient=False)
+    w = paddle.to_tensor(_rand((4, 3, 3, 3), 1) * 0.2, stop_gradient=False)
+    rm = Tensor(np.zeros(4, np.float32))
+    rv = Tensor(np.ones(4, np.float32))
+    with paddle.amp.auto_cast(level="O1"), layout.channels_last_scope():
+        y = F.conv2d(x, w, padding=1)
+        assert y.dtype == jnp.bfloat16 and y._layout == "NHWC"
+        z = F.batch_norm(y, rm, rv, training=True)
+        assert z.dtype == jnp.bfloat16
+    assert rm._data.dtype == jnp.float32      # EMA buffers never degrade
+    assert rv._data.dtype == jnp.float32
+    z.astype("float32").sum().backward()
+    assert w.grad is not None
+
+
+def test_mixed_layout_elementwise_falls_back_to_nchw():
+    """A transparent elementwise op combining a tagged-NHWC tensor with an
+    untagged NCHW-world tensor must NOT mix physical layouts: the planner
+    exits to NCHW for that op, so results match the plain path exactly
+    (code-review regression: x + conv(x) with square dims was silently
+    wrong; channel-broadcast scales crashed)."""
+    x_np = _rand((2, 8, 8, 8))                 # square dims: the silent case
+    w_np = _rand((8, 8, 3, 3), 1) * 0.2
+    s_np = _rand((1, 8, 1, 1), 2)              # NCHW channel-broadcast scale
+
+    x = paddle.to_tensor(x_np)
+    w = paddle.to_tensor(w_np)
+    s = paddle.to_tensor(s_np)
+    ref_res = (x + F.conv2d(x, w, padding=1)).numpy()
+    ref_scaled = (F.conv2d(x, w, padding=1) * s).numpy()
+
+    with layout.channels_last_scope():
+        out_res = x + F.conv2d(x, w, padding=1)       # untagged + tagged
+        out_scaled = F.conv2d(x, w, padding=1) * s    # tagged * NCHW scale
+    np.testing.assert_allclose(out_res.numpy(), ref_res, atol=1e-5,
+                               rtol=1e-5)
+    np.testing.assert_allclose(out_scaled.numpy(), ref_scaled, atol=1e-5,
+                               rtol=1e-5)
+
+    # tagged + tagged (the residual fast path) still stays channels-last
+    with layout.channels_last_scope():
+        a = F.conv2d(x, w, padding=1)
+        b = F.conv2d(x, w, padding=1)
+        c = a + b
+        assert c._layout == "NHWC"

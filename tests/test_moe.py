@@ -7,6 +7,7 @@ reference's op-only surface, so its gold standard is internal invariants
 """
 
 import jax
+from paddle_tpu.distributed.env import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -140,6 +141,6 @@ def test_global_scatter_gather_roundtrip():
         back = global_gather(sent, None, None)
         return back
 
-    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(spec,),
+    out = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
                                 out_specs=spec))(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))

@@ -98,6 +98,19 @@ def compare(old: List[dict], new: List[dict],
     return problems
 
 
+def compare_common(old: List[dict], new: List[dict],
+                   tolerance: float = DEFAULT_TOLERANCE) -> List[str]:
+    """Gate only the metrics present in BOTH records (no 'disappeared'
+    check). This is the in-run self-gate's comparator: a ``--quick`` bench
+    run (BERT only) or a run where a diagnostic leg failed must not log
+    every intentionally-skipped benchmark as a false regression; the full
+    cross-record CLI gate (:func:`compare`) keeps the disappearance check
+    for CI use."""
+    names = {m["metric"] for m in new}
+    return compare([m for m in old if m.get("metric") in names], new,
+                   tolerance)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     tol = DEFAULT_TOLERANCE

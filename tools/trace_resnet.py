@@ -29,11 +29,20 @@ def main():
 
     B = int(os.environ.get("RN_B", "256"))
     fmt = os.environ.get("RN_FMT", "NCHW")
+    # ablation toggles for docs/PERF_RESNET.md (layout × fusion × bf16):
+    #   RN_CL=0    disable the TrainStep channels-last rewrite
+    #   RN_FUSED=0 disable conv+BN+ReLU fusion
+    #   RN_AMP=0   run full f32 (no bf16 activation stream)
+    paddle.set_flags({
+        "jit_channels_last": os.environ.get("RN_CL", "1") != "0",
+        "fused_conv_bn": os.environ.get("RN_FUSED", "1") != "0",
+    })
+    use_amp = os.environ.get("RN_AMP", "1") != "0"
     paddle.seed(0)
     model = resnet50(num_classes=1000, data_format=fmt)
 
     def loss_fn(layer, xb, yb):
-        with paddle.amp.auto_cast(level="O1"):
+        with paddle.amp.auto_cast(enable=use_amp, level="O1"):
             return F.cross_entropy(layer(xb), yb)
 
     opt = Momentum(learning_rate=0.1, parameters=model.parameters(),
@@ -52,7 +61,10 @@ def main():
     for _ in range(5):
         out = step(x, y)
     float(out)
-    log(f"resnet50 B={B} {fmt}: {(time.perf_counter()-t0)/5*1e3:.1f} ms/step")
+    from paddle_tpu.core.flags import get_flag
+    log(f"resnet50 B={B} {fmt} cl={int(get_flag('jit_channels_last'))} "
+        f"fused={int(get_flag('fused_conv_bn'))} amp={int(use_amp)}: "
+        f"{(time.perf_counter()-t0)/5*1e3:.1f} ms/step")
 
     tdir = "/tmp/rn_trace"
     os.system(f"rm -rf {tdir}")
