@@ -11,6 +11,7 @@ Here flags live in a single registry; values are read from the environment
 
 from __future__ import annotations
 
+import contextlib
 import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
@@ -73,6 +74,25 @@ def get_flags(names) -> Dict[str, Any]:
     return {n: get_flag(n) for n in names}
 
 
+@contextlib.contextmanager
+def flag_scope(name: str, value: Any):
+    """Temporarily override a flag for a with-block.
+
+    Restores BOTH the previous value and the explicitly-set bit —
+    ``set_flags`` alone cannot do that (it forces ``explicitly_set``,
+    which would permanently shadow a ``FLAGS_*`` env override)."""
+    flag = _REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(f"Unknown flag: {name!r}")
+    saved = (flag.value, flag.explicitly_set)
+    flag.value = value
+    flag.explicitly_set = True
+    try:
+        yield
+    finally:
+        flag.value, flag.explicitly_set = saved
+
+
 # ---------------------------------------------------------------------------
 # Core flag set (TPU-relevant subset of the reference's platform/flags.cc)
 # ---------------------------------------------------------------------------
@@ -99,6 +119,23 @@ define_flag("fused_conv_bn", True,
             "XLA, one tape node in eager. f32 EMA buffers preserved under "
             "AMP.")
 define_flag("log_level", "0", "Verbose log level (VLOG analogue).")
+define_flag("scan_layers", True,
+            "Run homogeneous transformer decoder/encoder stacks as ONE "
+            "jax.lax.scan over layer-stacked parameters (nn.scan): trace+"
+            "compile cost drops from O(num_layers) to O(1). Per-layer "
+            "state_dict names and the LayerList API are unchanged "
+            "(docs/PARITY.md internal-layout contract). Models opt in via "
+            "their config (GPTConfig/BertConfig/ErnieConfig.scan_layers); "
+            "this flag is the global kill switch.")
+define_flag("chunked_ce_threshold", 4096,
+            "Vocab size at or above which softmax cross-entropy streams "
+            "over vocab chunks (nn.chunked_ce): online logsumexp with f32 "
+            "accumulation, never materializing the full-vocab f32 logits/"
+            "log-probs. 0 disables the chunked path.")
+define_flag("chunked_ce_chunk", 8192,
+            "Vocab chunk width for the streamed cross-entropy (rounded "
+            "down to the vocab size; any remainder tail is masked, so "
+            "non-multiple vocab sizes are exact).")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -19,7 +19,49 @@ import jax
 from ....core.tensor import Tensor, apply
 from ....nn.layer import Layer
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "resolve_checkpoint_policy"]
+
+#: named selective-remat policies (jax.checkpoint_policies). The TPU
+#: default for transformer stacks is ``dots_with_no_batch_dims_saveable``:
+#: keep MXU (matmul) outputs resident, rematerialize only the cheap
+#: elementwise tail — far less recompute FLOPs than full remat for a
+#: modest HBM cost (the T5X/MaxText recipe).
+_POLICY_NAMES = (
+    # NOTE: only plain PREDICATES belong here. jax.checkpoint_policies
+    # also exports factories (offload_dot_with_no_batch_dims,
+    # save_only_these_names, ...) that take configuration and RETURN a
+    # predicate — pass the constructed predicate as a callable instead.
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots",
+    "checkpoint_dots_with_no_batch_dims",
+    "everything_saveable",
+    "nothing_saveable",
+)
+_POLICY_ALIASES = {
+    "save_dots": "dots_saveable",
+    "save_dots_no_batch": "dots_with_no_batch_dims_saveable",
+    "full": "nothing_saveable",
+    "none": "everything_saveable",
+}
+
+
+def resolve_checkpoint_policy(policy):
+    """Resolve a remat policy spec to a ``jax.checkpoint_policies`` predicate.
+
+    Accepts None (full remat — jax.checkpoint's default), a callable
+    (returned as-is), or a policy name / alias string. Model configs carry
+    the string form (``recompute_policy='dots_with_no_batch_dims_saveable'``)
+    so configs stay picklable/serializable."""
+    if policy is None or callable(policy):
+        return policy
+    name = _POLICY_ALIASES.get(str(policy), str(policy))
+    if name not in _POLICY_NAMES:
+        raise ValueError(
+            f"unknown recompute policy {policy!r}; expected one of "
+            f"{sorted(_POLICY_NAMES + tuple(_POLICY_ALIASES))} or a "
+            "jax.checkpoint_policies callable")
+    return getattr(jax.checkpoint_policies, name)
 
 
 def recompute(function, *args, use_reentrant: bool = True,
@@ -39,6 +81,7 @@ def recompute(function, *args, use_reentrant: bool = True,
     FLOPs/HBM trade than full recompute on TPU.
     """
     del use_reentrant, preserve_rng_state   # parity knobs; single behavior
+    policy = resolve_checkpoint_policy(policy)
 
     # Gradients only flow through explicit apply() args, so parameters must
     # be passed in — harvest them from the callable: the Layer itself, a

@@ -134,7 +134,13 @@ class ParallelCrossEntropy(Layer):
     reference: mp_layers.py:249 → c_softmax_with_cross_entropy_op.cu — the
     max/sum reductions run across the vocab-sharded axis. Here the stable
     composition's reductions are partitioned by GSPMD (logits arrive sharded
-    [..., V~mp] from a gather_output=False column layer)."""
+    [..., V~mp] from a gather_output=False column layer).
+
+    Single chip (no mp mesh): large vocabularies stream over chunks with an
+    online f32 logsumexp (nn/chunked_ce.py) instead of materializing the
+    full-vocab f32 log-probs — the dense mp-sharded composition is kept
+    whenever an mp mesh is active, since GSPMD partitions its reductions
+    across the vocab shards (chunk slicing would fight that layout)."""
 
     def __init__(self, mp_group=None, name=None):
         super().__init__()
@@ -142,19 +148,27 @@ class ParallelCrossEntropy(Layer):
     def forward(self, logits, label):
         from ....core.tensor import apply
         import jax.numpy as jnp
+        from ....nn import chunked_ce as _cce
+
+        vocab = logits.shape[-1]
+        use_chunked = _mesh() is None and _cce.enabled_for(vocab)
+        chunk = _cce.chunk_size_for(vocab) if use_chunked else 0
 
         def _ce(lg, lab):
+            ids = lab.astype(jnp.int32)
+            if ids.ndim == lg.ndim:
+                ids = jnp.squeeze(ids, -1)
+            if use_chunked:
+                return _cce.hard_nll(lg, ids, chunk=chunk)[..., None]
             lg32 = lg.astype(jnp.float32)
             m = jnp.max(lg32, axis=-1, keepdims=True)
             z = lg32 - jax.lax.stop_gradient(m)
             lse = jnp.log(jnp.sum(jnp.exp(z), axis=-1))
-            ids = lab.astype(jnp.int32)
-            if ids.ndim == lg.ndim:
-                ids = jnp.squeeze(ids, -1)
             tgt = jnp.take_along_axis(z, ids[..., None], axis=-1)[..., 0]
             return (lse - tgt)[..., None]
 
-        return apply(_ce, logits, label, name="parallel_cross_entropy")
+        return apply(_ce, logits, label, name="parallel_cross_entropy",
+                     _cache_token=("parallel_ce", use_chunked, chunk))
 
 
 def split(x, size, operation: str, axis: int = 0, gather_out: bool = True,

@@ -235,6 +235,21 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     return _decorate
 
 
+def _donation_safe() -> bool:
+    """jax 0.4.37 XLA:CPU hazard: executables reloaded from the PERSISTENT
+    compilation cache can lose the input-output aliasing of donated
+    buffers when the program contains while/scan bodies (the
+    scan-over-layers train step) — warm-cache steps then read clobbered
+    parameter buffers and return garbage losses (segfaults observed too).
+    Reproduced with a pure-jax scan+grad+donate step on this CPU backend;
+    TPU executable serialization is unaffected. Donation is therefore
+    kept everywhere EXCEPT cpu-backend-with-persistent-cache (the test
+    environment, where donation buys nothing)."""
+    if jax.default_backend() != "cpu":
+        return True
+    return not (jax.config.jax_compilation_cache_dir or "")
+
+
 class TrainStep:
     """Compile (model, loss, optimizer) into ONE donated XLA train step.
 
@@ -504,8 +519,11 @@ class TrainStep:
             jitted = self._jitted.get(sig)
             if jitted is None:
                 fn = self._make_accum_step(treedef)
+                # _donation_safe re-checked per compiled entry: the
+                # persistent cache may be enabled after construction
                 jitted = jax.jit(fn, donate_argnums=(2,)
-                                 if self._donate else ())
+                                 if self._donate and _donation_safe()
+                                 else ())
                 self._jitted[sig] = jitted
             with _control_flow_guidance():
                 self.buffers, self._acc_grads, loss = jitted(
@@ -519,7 +537,7 @@ class TrainStep:
         if jitted is None:
             fn = self._make_apply_step(treedef, check_finite=check)
             jitted = jax.jit(fn, donate_argnums=(0, 2, 3)
-                             if self._donate else ())
+                             if self._donate and _donation_safe() else ())
             self._jitted[sig] = jitted
         with _control_flow_guidance():
             out = jitted(self.params, self.buffers, self.opt_state,
@@ -549,7 +567,7 @@ class TrainStep:
         jitted = self._jitted.get(sig)
         if jitted is None:
             fn = self._make_step(treedef, check_finite=check)
-            donate = (0, 2) if self._donate else ()
+            donate = (0, 2) if self._donate and _donation_safe() else ()
             jitted = jax.jit(fn, donate_argnums=donate)
             self._jitted[sig] = jitted
         self.step_count += 1

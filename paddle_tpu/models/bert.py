@@ -44,6 +44,13 @@ class BertConfig:
     hidden_dropout_prob: float = 0.1
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
+    #: run the encoder stack as one jax.lax.scan over layer-stacked params
+    #: (nn.scan; O(1) trace/compile in num_layers, state_dict unchanged)
+    scan_layers: bool = True
+    use_recompute: bool = False
+    #: selective-remat policy name (fleet.utils.recompute.
+    #: resolve_checkpoint_policy); None = full remat
+    recompute_policy: Optional[str] = None
 
 
 class BertEmbeddings(Layer):
@@ -85,6 +92,9 @@ class BertModel(Layer):
             attn_dropout=cfg.attention_dropout_prob,
             act_dropout=0.0, normalize_before=False)
         self.encoder = TransformerEncoder(enc_layer, cfg.num_layers)
+        self.encoder.enable_scan = cfg.scan_layers
+        self.encoder.use_recompute = cfg.use_recompute
+        self.encoder.recompute_policy = cfg.recompute_policy
         from ..nn.layers.common import Linear
         self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
 
@@ -135,18 +145,16 @@ class BertForMaskedLM(Layer):
         return apply(head, *args, name="mlm_head")
 
     def loss(self, prediction_scores, masked_lm_labels, masked_lm_weights=None):
-        """Mean CE over masked positions; labels [B, M], weights [B, M]."""
+        """Mean CE over masked positions; labels [B, M], weights [B, M].
+
+        Above the chunked-CE vocab threshold the logsumexp streams over
+        vocab chunks (nn/chunked_ce.py — online f32 accumulation, no
+        full-vocab f32 log-probs); below it the dense composition runs."""
+        from ..nn import chunked_ce as _cce
+        chunked = _cce.enabled_for(prediction_scores.shape[-1])
 
         def ce(lg, lab, *ww):
-            lg32 = lg.astype(jnp.float32)
-            lse = jax.nn.logsumexp(lg32, axis=-1)
-            ids = lab.astype(jnp.int32)
-            tgt = jnp.take_along_axis(lg32, ids[..., None], axis=-1)[..., 0]
-            per = lse - tgt
-            if ww:
-                m = ww[0].astype(jnp.float32)
-                return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
-            return jnp.mean(per)
+            return _cce.masked_lm_loss(lg, lab, *ww, chunked=chunked)
 
         args = [prediction_scores, masked_lm_labels] + (
             [masked_lm_weights] if masked_lm_weights is not None else [])

@@ -17,6 +17,7 @@ without model rewrites.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +50,13 @@ class ErnieConfig:
     hidden_dropout_prob: float = 0.1
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
+    #: run the encoder stack as one jax.lax.scan over layer-stacked params
+    #: (nn.scan; O(1) trace/compile in num_layers, state_dict unchanged)
+    scan_layers: bool = True
+    use_recompute: bool = False
+    #: selective-remat policy name (fleet.utils.recompute.
+    #: resolve_checkpoint_policy); None = full remat
+    recompute_policy: Optional[str] = None
 
 
 class ErnieEmbeddings(Layer):
@@ -97,6 +105,9 @@ class ErnieModel(Layer):
             attn_dropout=cfg.attention_dropout_prob, act_dropout=0.0,
             normalize_before=False)
         self.encoder = TransformerEncoder(enc_layer, cfg.num_layers)
+        self.encoder.enable_scan = cfg.scan_layers
+        self.encoder.use_recompute = cfg.use_recompute
+        self.encoder.recompute_policy = cfg.recompute_policy
         self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -150,16 +161,13 @@ class ErnieForPretraining(Layer):
 
     def loss(self, mlm_scores, sop_scores, masked_lm_labels, sop_labels,
              masked_lm_weights=None):
+        from ..nn import chunked_ce as _cce
+        chunked = _cce.enabled_for(mlm_scores.shape[-1])
+
         def mlm_ce(lg, lab, *ww):
-            lg32 = lg.astype(jnp.float32)
-            lse = jax.nn.logsumexp(lg32, axis=-1)
-            tgt = jnp.take_along_axis(
-                lg32, lab.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-            per = lse - tgt
-            if ww:
-                m = ww[0].astype(jnp.float32)
-                return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
-            return jnp.mean(per)
+            # streamed-vocab CE above the threshold (nn/chunked_ce.py),
+            # dense logsumexp below — one shared epilogue with BERT
+            return _cce.masked_lm_loss(lg, lab, *ww, chunked=chunked)
 
         args = [mlm_scores, masked_lm_labels] + (
             [masked_lm_weights] if masked_lm_weights is not None else [])

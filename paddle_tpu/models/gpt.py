@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.flags import matmul_precision
 from ..core.tensor import apply
 from ..distributed import env as dist_env
+from ..distributed.fleet.utils.recompute import recompute
 from ..distributed.meta_parallel.parallel_layers.mp_layers import (
     VocabParallelEmbedding, ParallelCrossEntropy)
 from ..nn import functional as F
@@ -49,6 +50,7 @@ from ..nn.initializer import Constant, Normal
 from ..nn.layer import Layer, LayerList
 from ..nn.layers.common import Dropout, Embedding
 from ..nn.layers.norm import LayerNorm
+from ..nn.scan import can_scan_layers, scan_layers
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_xl"]
@@ -69,6 +71,16 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
     use_recompute: bool = False
+    #: selective-remat policy name for use_recompute (see
+    #: fleet.utils.recompute.resolve_checkpoint_policy); None = full remat.
+    #: 'dots_with_no_batch_dims_saveable' keeps MXU outputs resident and
+    #: rematerializes only the elementwise tail — the TPU default trade.
+    recompute_policy: Optional[str] = None
+    #: run the decoder stack as one jax.lax.scan over layer-stacked params
+    #: (nn.scan): O(1) trace+compile in num_layers, per-layer state_dict
+    #: names and LayerList API unchanged. Falls back to the Python loop for
+    #: KV-cache decoding or heterogeneous stacks.
+    scan_layers: bool = True
     sequence_parallel: bool = False
 
     @property
@@ -293,15 +305,25 @@ class GPTModel(Layer):
                 "into the fixed-size KV buffers); models/generation.py "
                 "threads it automatically")
         new_caches = [] if caches is not None else None
-        for i, blk in enumerate(self.layers):
-            if caches is not None:
-                x, c = blk(x, caches[i], pos=cache_pos)
-                new_caches.append(c)
-            elif self.cfg.use_recompute and self.training:
-                from ..distributed.fleet.utils import recompute
-                x = recompute(blk, x)
-            else:
-                x = blk(x)
+        if caches is None and self.cfg.scan_layers \
+                and can_scan_layers(self.layers):
+            # one lax.scan over the layer-stacked params: the block body
+            # traces/compiles once regardless of depth; selective remat
+            # composes inside the scanned body
+            x = scan_layers(
+                self.layers, x,
+                use_recompute=self.cfg.use_recompute and self.training,
+                policy=self.cfg.recompute_policy,
+                name="gpt_scan_layers")
+        else:
+            for i, blk in enumerate(self.layers):
+                if caches is not None:
+                    x, c = blk(x, caches[i], pos=cache_pos)
+                    new_caches.append(c)
+                elif self.cfg.use_recompute and self.training:
+                    x = recompute(blk, x, policy=self.cfg.recompute_policy)
+                else:
+                    x = blk(x)
         x = self.final_norm(x)
         return x if caches is None else (x, new_caches)
 

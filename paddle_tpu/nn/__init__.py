@@ -1,7 +1,9 @@
 """paddle_tpu.nn — layer library (reference surface: python/paddle/nn/)."""
 
 from . import functional  # noqa: F401
+from . import chunked_ce  # noqa: F401  (streamed-vocab cross entropy)
 from . import layout  # noqa: F401  (installs the channels-last planner hooks)
+from . import scan  # noqa: F401  (scan-over-layers for homogeneous stacks)
 from . import initializer  # noqa: F401
 from .initializer import ParamAttr  # noqa: F401
 from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

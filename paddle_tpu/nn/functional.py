@@ -1180,8 +1180,47 @@ def _reduce(loss, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
                   name=None):
-    """Softmax cross entropy (reference: nn/functional/loss.py cross_entropy)."""
+    """Softmax cross entropy (reference: nn/functional/loss.py cross_entropy).
+
+    Above ``FLAGS_chunked_ce_threshold`` vocab entries (last-axis softmax,
+    no label smoothing) the loss streams over vocab chunks with an online
+    f32 logsumexp instead of materializing full-vocab f32 log-probs — see
+    nn/chunked_ce.py. Same semantics (ignore_index / soft_label / weights /
+    reduction), custom-VJP backward."""
     w = _t(weight) if weight is not None else None
+    inp_t = _t(input)
+    n_classes = inp_t.shape[axis]
+    from . import chunked_ce as _cce
+    if (use_softmax and not label_smoothing
+            and axis in (-1, inp_t.ndim - 1)
+            and _cce.enabled_for(n_classes)):
+        chunk = _cce.chunk_size_for(n_classes)
+
+        def _ce_chunked(logits, lab, *maybe_w):
+            if soft_label:
+                loss = _cce.soft_nll(logits, lab.astype(jnp.float32),
+                                     chunk=chunk)
+                valid = jnp.ones_like(loss, jnp.float32)
+            else:
+                ids = lab.astype(jnp.int32)
+                if ids.ndim == logits.ndim:
+                    ids = jnp.squeeze(ids, -1)
+                valid = (ids != ignore_index).astype(jnp.float32)
+                safe_ids = jnp.where(ids == ignore_index, 0, ids)
+                loss = _cce.hard_nll(logits, safe_ids, chunk=chunk) * valid
+                if maybe_w:
+                    sample_w = jnp.take(maybe_w[0], safe_ids, axis=0) * valid
+                    loss = loss * sample_w
+                    valid = sample_w
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid), 1e-12)
+                return jnp.sum(loss) / denom
+            return _reduce(loss, reduction)
+
+        args = [inp_t, _t(label)] + ([w] if w is not None else [])
+        return apply(_ce_chunked, *args, name="cross_entropy",
+                     _cache_token=("ce_chunked", reduction, ignore_index,
+                                   bool(soft_label), chunk))
 
     def _ce(logits, lab, *maybe_w):
         if use_softmax:

@@ -159,6 +159,13 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
+    """Encoder stack. ``enable_scan`` (opt-in, set by the model configs —
+    BERT/ERNIE default it on) runs the homogeneous stack as ONE
+    jax.lax.scan over layer-stacked params (nn.scan): O(1) trace/compile in
+    num_layers, per-layer state_dict names unchanged. ``use_recompute`` +
+    ``recompute_policy`` select (selective) activation remat for the stack
+    (fleet.utils.recompute semantics, composed inside the scanned body)."""
+
     def __init__(self, encoder_layer, num_layers, norm=None):
         super().__init__()
         import copy
@@ -166,13 +173,35 @@ class TransformerEncoder(Layer):
                                 [_clone_layer(encoder_layer) for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
+        self.enable_scan = False
+        self.use_recompute = False
+        self.recompute_policy = None
 
     def forward(self, src, src_mask=None, cache=None):
+        from ..scan import can_scan_layers, scan_layers
+        if cache is None and self.enable_scan \
+                and can_scan_layers(self.layers):
+            extra = (src_mask,) if src_mask is not None else ()
+            output = scan_layers(
+                self.layers, src, *extra,
+                use_recompute=self.use_recompute and self.training,
+                policy=self.recompute_policy,
+                name="encoder_scan_layers")
+            if self.norm is not None:
+                output = self.norm(output)
+            return output
         output = src
         new_caches = []
         for i, mod in enumerate(self.layers):
             if cache is None:
-                output = mod(output, src_mask)
+                if self.use_recompute and self.training:
+                    from ...distributed.fleet.utils.recompute import recompute
+                    output = recompute(mod, output, src_mask,
+                                       policy=self.recompute_policy) \
+                        if src_mask is not None else \
+                        recompute(mod, output, policy=self.recompute_policy)
+                else:
+                    output = mod(output, src_mask)
             else:
                 output, new_cache = mod(output, src_mask, cache[i])
                 new_caches.append(new_cache)

@@ -70,44 +70,53 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
     else:
         example = [jnp.zeros(tuple(s.shape), s.dtype) for s in specs]
 
-    if isinstance(layer, Layer):
-        was_training = layer.training
-        layer.eval()
-        params = param_arrays(layer)
-        buffers = buffer_arrays(layer)
-        flat_params = list(params.values()) + list(buffers.values())
+    # the ONNX op set has no lax.scan/while analogue in this converter:
+    # trace transformer stacks in their unrolled loop layout and losses in
+    # their dense (non-streamed) composition — both are internal trace-time
+    # layouts (nn/scan.py, nn/chunked_ce.py), so forcing them here changes
+    # nothing about the exported model's weights/semantics
+    from ..core.flags import flag_scope
 
-        # key hoisted OUT of the traced fn: creating it inside would
-        # record random_seed/random_wrap primitives even though eval-mode
-        # forwards never consume randomness
-        _key = jax.random.key(0)
+    with flag_scope("scan_layers", False), \
+            flag_scope("chunked_ce_threshold", 0):
+        if isinstance(layer, Layer):
+            was_training = layer.training
+            layer.eval()
+            params = param_arrays(layer)
+            buffers = buffer_arrays(layer)
+            flat_params = list(params.values()) + list(buffers.values())
 
-        def fn(*all_args):
-            inputs = all_args[:len(example)]
-            pvals = all_args[len(example):len(example) + len(params)]
-            bvals = all_args[len(example) + len(params):]
-            p = dict(zip(params.keys(), pvals))
-            bufs = dict(zip(buffers.keys(), bvals))
-            with bind(layer, p, bufs), no_grad(), trace_rng(_key):
-                out = layer(*[Tensor(i) for i in inputs])
-            return unwrap(out)
+            # key hoisted OUT of the traced fn: creating it inside would
+            # record random_seed/random_wrap primitives even though
+            # eval-mode forwards never consume randomness
+            _key = jax.random.key(0)
 
-        try:
-            closed = jax.make_jaxpr(fn)(*example, *flat_params)
-        finally:
-            if was_training:
-                layer.train()
-        consts = flat_params
-    else:
-        _key = jax.random.key(0)
+            def fn(*all_args):
+                inputs = all_args[:len(example)]
+                pvals = all_args[len(example):len(example) + len(params)]
+                bvals = all_args[len(example) + len(params):]
+                p = dict(zip(params.keys(), pvals))
+                bufs = dict(zip(buffers.keys(), bvals))
+                with bind(layer, p, bufs), no_grad(), trace_rng(_key):
+                    out = layer(*[Tensor(i) for i in inputs])
+                return unwrap(out)
 
-        def fn(*inputs):
-            with no_grad(), trace_rng(_key):
-                out = layer(*[Tensor(i) for i in inputs])
-            return unwrap(out)
+            try:
+                closed = jax.make_jaxpr(fn)(*example, *flat_params)
+            finally:
+                if was_training:
+                    layer.train()
+            consts = flat_params
+        else:
+            _key = jax.random.key(0)
 
-        closed = jax.make_jaxpr(fn)(*example)
-        consts = []
+            def fn(*inputs):
+                with no_grad(), trace_rng(_key):
+                    out = layer(*[Tensor(i) for i in inputs])
+                return unwrap(out)
+
+            closed = jax.make_jaxpr(fn)(*example)
+            consts = []
 
     names = [f"x{i}" for i in range(len(example))]
     data = jaxpr_to_onnx(closed, names, consts,

@@ -1,3 +1,5 @@
 """paddle.utils namespace (reference parity: python/paddle/utils)."""
 
+from . import compilation  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from .compilation import CompileCounter  # noqa: F401
