@@ -516,6 +516,11 @@ def main() -> None:
     import paddle_tpu as paddle
     # all benches measure the production policy: bf16 MXU, f32 accumulate
     paddle.set_flags({"tpu_matmul_precision": "default"})
+    # telemetry on for the whole run: TrainStep step timings + compile/
+    # recompile counters land in the monitor registry, dumped as JSONL
+    # next to the BENCH_*.json records at the end (registry writes are
+    # host-side dict updates — noise floor, not a timed-loop distortion)
+    paddle.set_flags({"monitor": True})
     log(f"devices: {jax.devices()}")
     log(f"compilation cache: {jax.config.jax_compilation_cache_dir} "
         "(compile+step1 timings below collapse on warm runs)")
@@ -552,6 +557,24 @@ def main() -> None:
     for m in metrics:
         if m is not None:
             print(json.dumps(m), flush=True)
+
+    # metrics-registry dump NEXT TO the BENCH_*.json records: perf numbers
+    # now travel with their recompile counts, cache hit rates, step-time
+    # histograms and comms counters (tools/monitor_report.py renders it).
+    # File output only — stdout keeps its one-JSON-line-per-metric
+    # contract, so check_bench.compare_common gating is unaffected.
+    try:
+        import os as _os
+        from paddle_tpu.monitor import get_registry
+        from paddle_tpu.utils.compilation import publish_compile_counts
+        publish_compile_counts()
+        mpath = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                              "BENCH_monitor.jsonl")
+        get_registry().dump_jsonl(mpath, extra={"source": "bench"})
+        log(f"monitor: registry dumped to {mpath} "
+            "(render: python tools/monitor_report.py)")
+    except Exception as e:                       # telemetry must never
+        log(f"monitor dump skipped: {e!r}")      # sink the metrics
 
     # self-gate against the newest driver record so a regression is
     # visible in this run's own log (the CLI gate remains for CI use)

@@ -95,6 +95,7 @@ from . import distribution  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401
+from . import monitor  # noqa: F401
 from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
 from . import slim  # noqa: F401

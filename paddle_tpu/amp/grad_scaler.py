@@ -31,11 +31,27 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._skip_count = 0         # optimizer steps skipped on inf grads
         self._unscaled: set = set()  # ids of optimizers unscaled this step
         self._stepped: set = set()   # ids of optimizers stepped this step
 
     def is_enable(self):
         return self._enable
+
+    @property
+    def found_inf(self) -> bool:
+        """Whether the LAST unscale found non-finite gradients (the step
+        about to be / just skipped). The NaN watchdog
+        (monitor.numerics.NaNWatchdog) consults this to tell 'dynamic
+        loss scaling doing its job' from a real numerics failure."""
+        return self._found_inf
+
+    @property
+    def skip_count(self) -> int:
+        """Total optimizer steps skipped because gradients were
+        non-finite (mirrored into the monitor registry as
+        ``amp_skipped_steps_total``)."""
+        return self._skip_count
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -96,7 +112,23 @@ class AmpScaler:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            self._note_skip()
         self._stepped.add(id(optimizer))
+
+    def _note_skip(self):
+        """A skipped optimizer step (inf/nan grads): count locally and in
+        the metrics registry so the AMP skip rate shows up next to the
+        NaN-watchdog trips in monitor reports."""
+        self._skip_count += 1
+        try:
+            from ..monitor import get_registry
+            get_registry().counter(
+                "amp_skipped_steps_total",
+                "optimizer steps skipped by GradScaler on non-finite "
+                "gradients").inc()
+        except Exception:
+            pass
 
     def update(self):
         self._unscaled.clear()
@@ -134,12 +166,14 @@ class AmpScaler:
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps, "enable": self._enable}
+                "bad_steps": self._bad_steps, "enable": self._enable,
+                "skip_count": self._skip_count}
 
     def load_state_dict(self, state):
         self._scale = state["scale"]
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._skip_count = state.get("skip_count", 0)
 
 
 class GradScaler(AmpScaler):

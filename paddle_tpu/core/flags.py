@@ -136,6 +136,15 @@ define_flag("chunked_ce_chunk", 8192,
             "Vocab chunk width for the streamed cross-entropy (rounded "
             "down to the vocab size; any remainder tail is masked, so "
             "non-multiple vocab sizes are exact).")
+define_flag("monitor", False,
+            "Stream hot-path telemetry into the paddle_tpu.monitor metrics "
+            "registry: per-step TrainStep wall/dispatch timings, compile/"
+            "recompile counters, grad-accum and LocalSGD sync boundaries. "
+            "Off (default) = ZERO per-step registry writes on the train "
+            "step hot path (tests pin this). Eager collective tracing is "
+            "always on (registry writes are noise next to a shard_map "
+            "dispatch) and the check_numerics watchdog is its own "
+            "TrainStep argument — neither is gated by this flag.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

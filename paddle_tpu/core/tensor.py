@@ -426,6 +426,13 @@ _EAGER_FN_CACHE_MAX = 1024
 _EAGER_CACHE_STATS = {"hits": 0, "misses": 0}
 
 
+def eager_cache_stats() -> dict:
+    """Snapshot of the eager-op jit-cache hit/miss counters (process
+    lifetime, monotonic). TrainStep.stats() and the monitor registry diff
+    two snapshots to report a window's hit rate."""
+    return dict(_EAGER_CACHE_STATS)
+
+
 def _eager_cacheable(fn, static_kw) -> bool:
     if getattr(fn, "__closure__", None) is not None:
         return False

@@ -32,7 +32,9 @@ on this-process tensors additionally route through multihost utilities.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -210,6 +212,63 @@ def _eager_shardmap(group: Group, key, body, n_out_stacked=True):
     return f
 
 
+def _eager_warm(group: Group, key) -> bool:
+    """Whether this (group, op-key)'s shard_map wrapper is already built.
+    Approximate compile detection: a warm wrapper can still trigger an
+    XLA compile on a new operand shape, but the common skew source — the
+    first call paying jit+compile — is caught."""
+    return (group.id, group.axis_name, group.nranks, key) in _eager_cache
+
+
+@contextlib.contextmanager
+def _comm_trace(op: str, group: Group, x, cache_key=None):
+    """Comms observability for every eager collective (tentpole pillar 3;
+    reference analogue: the NCCL comm events CUPTI puts on the
+    device_tracer timeline). Records op name, group axis/size, operand
+    bytes and dispatch latency into the monitor registry, and emits a
+    ``comm::<op>`` RecordEvent so collectives show up on host timelines
+    when a profiler window is open.
+
+    Latency here is DISPATCH latency (time for the XLA call to return,
+    enqueue included, device completion not) — the single-controller
+    eager model has no per-collective completion event; use
+    ``wait``/``block_until_ready`` timings for on-device time. A COLD
+    call (shard_map wrapper not built yet) pays trace+compile, orders of
+    magnitude above steady-state dispatch — those land in the separate
+    ``comm_cold_dispatch_seconds`` histogram so the latency series stays
+    readable. Telemetry must never sink the collective itself, hence the
+    broad guards."""
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    warm = cache_key is None or _eager_warm(group, cache_key)
+    try:
+        from ..profiler import RecordEvent
+        span = RecordEvent(f"comm::{op}")
+    except Exception:
+        span = contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with span:
+        yield
+    dt = time.perf_counter() - t0
+    try:
+        from ..monitor import get_registry
+        reg = get_registry()
+        labels = {"op": op, "group": group.axis_name,
+                  "nranks": group.nranks}
+        reg.counter("comm_ops_total",
+                    "eager collective dispatches").inc(**labels)
+        reg.counter("comm_bytes_total",
+                    "operand bytes moved through eager collectives"
+                    ).inc(nbytes, **labels)
+        reg.histogram("comm_latency_seconds" if warm
+                      else "comm_cold_dispatch_seconds",
+                      "eager collective dispatch latency (warm wrapper)"
+                      if warm else
+                      "first-call eager collective dispatch incl. "
+                      "trace+compile").observe(dt, **labels)
+    except Exception:
+        pass
+
+
 def _check_stacked(arr, group: Group, opname: str):
     if arr.ndim == 0 or arr.shape[0] != group.nranks:
         raise ValueError(
@@ -252,7 +311,8 @@ def all_reduce(tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None,
             return jnp.broadcast_to(_pprod(s, (ax,)), s.shape)
         return jnp.broadcast_to(_LAX_REDUCE[op](s, ax), s.shape)
 
-    out = _eager_shardmap(g, ("all_reduce", op), body)(x)
+    with _comm_trace("all_reduce", g, x, ("all_reduce", op)):
+        out = _eager_shardmap(g, ("all_reduce", op), body)(x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -313,7 +373,8 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
         def body(s):
             return jax.lax.all_gather(s[0], ax)[None]
 
-        out = _eager_shardmap(g, ("all_gather",), body)(x)
+        with _comm_trace("all_gather", g, x, ("all_gather",)):
+            out = _eager_shardmap(g, ("all_gather",), body)(x)
         return _rewrap(out, tensor_or_list)
 
     # list-filling parity form
@@ -384,7 +445,8 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
         idx = jax.lax.axis_index(ax)
         return jnp.where(idx == dst_local, red, s)
 
-    out = _eager_shardmap(g, ("reduce", op, dst_local), body)(x)
+    with _comm_trace("reduce", g, x, ("reduce", op, dst_local)):
+        out = _eager_shardmap(g, ("reduce", op, dst_local), body)(x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -415,7 +477,8 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
     def body(s):
         return jax.lax.all_gather(s[0], ax)[src_local][None]
 
-    out = _eager_shardmap(g, ("broadcast", src_local), body)(x)
+    with _comm_trace("broadcast", g, x, ("broadcast", src_local)):
+        out = _eager_shardmap(g, ("broadcast", src_local), body)(x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -488,7 +551,8 @@ def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None
         return jax.lax.all_to_all(s, ax, split_axis=1, concat_axis=0,
                                   tiled=False).swapaxes(0, 1)
 
-    out = _eager_shardmap(g, ("alltoall",), body)(x)
+    with _comm_trace("alltoall", g, x, ("alltoall",)):
+        out = _eager_shardmap(g, ("alltoall",), body)(x)
     return _rewrap(out, in_tensor_list)
 
 
@@ -548,7 +612,9 @@ def ppermute_shift(x, group: Optional[Group] = None, shift: int = 1):
     def body(s):
         return jax.lax.ppermute(s, ax, perm)
 
-    return _rewrap(_eager_shardmap(g, ("ppermute", shift), body)(arr), x)
+    with _comm_trace("ppermute_shift", g, arr, ("ppermute", shift)):
+        out = _eager_shardmap(g, ("ppermute", shift), body)(arr)
+    return _rewrap(out, x)
 
 
 def barrier(group: Optional[Group] = None):

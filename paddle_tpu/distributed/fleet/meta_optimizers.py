@@ -65,6 +65,7 @@ class LocalSGDTrainStep:
         self._k0 = self.k_steps
         self._loss0: Optional[float] = None
         self.step_count = 0
+        self._stats = {"localsgd_syncs": 0, "local_steps": 0}
         self._make_rng = make_rng
         D = mesh.shape[axis]
         self.num_replicas = D
@@ -194,7 +195,23 @@ class LocalSGDTrainStep:
         # per-step float() would serialize dispatch between local steps
         if self.adaptive and self._loss0 is None:
             self._loss0 = max(float(loss[0]), 1e-12)
+        self._stats["local_steps"] += 1
         if self.step_count % self.k_steps == 0:
+            # LocalSGD SYNC boundary: replicas average parameters here —
+            # surfaced to the monitor registry so the k-step cadence (and
+            # AdaComm's adaptation of it) is observable next to the step
+            # timings (docs/OBSERVABILITY.md)
+            self._stats["localsgd_syncs"] += 1
+            from ...core.flags import get_flag
+            if get_flag("monitor"):
+                from ...monitor import get_registry
+                reg = get_registry()
+                reg.counter("localsgd_syncs_total",
+                            "LocalSGD parameter-averaging boundaries"
+                            ).inc(axis=self.axis)
+                reg.gauge("localsgd_k_steps",
+                          "current LocalSGD sync period (AdaComm adapts "
+                          "this)").set(self.k_steps, axis=self.axis)
             self.params = self._sync(self.params)
             if self.adaptive:
                 # AdaComm: k_t = ceil(k_0 * sqrt(F(w_t) / F(w_0)))
@@ -205,6 +222,14 @@ class LocalSGDTrainStep:
                                           / self._loss0))
                 self.k_steps = min(max(k, self.min_k), self.max_k)
         return Tensor(loss[0])
+
+    def stats(self) -> dict:
+        """Telemetry snapshot (TrainStep.stats() analogue): local steps,
+        parameter-averaging sync boundaries, and the current/initial k."""
+        d = dict(self._stats)
+        d.update(steps=self.step_count, k_steps=self.k_steps,
+                 initial_k_steps=self._k0, num_replicas=self.num_replicas)
+        return d
 
     def sync_to_layer(self):
         """Average replicas and write back into the Layer."""

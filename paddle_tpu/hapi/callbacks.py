@@ -8,7 +8,8 @@ import time
 from typing import List, Optional
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "config_callbacks", "CallbackList"]
+           "EarlyStopping", "MonitorCallback", "config_callbacks",
+           "CallbackList"]
 
 
 class Callback:
@@ -181,6 +182,56 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience and self.model is not None:
                 self.model.stop_training = True
+
+
+class MonitorCallback(Callback):
+    """Stream the monitor metrics registry to an append-only JSONL file
+    (paddle_tpu.monitor; render with ``tools/monitor_report.py``).
+
+    Every epoch end appends the full registry snapshot tagged with the
+    epoch number (plus a final ``event="train_end"`` snapshot), so the
+    file is a per-epoch time series of counters — recompiles, comms
+    bytes, step-time histograms — for the whole fit() run. The registry
+    is resolved at dump time, so ``monitor.scoped_registry`` blocks and
+    late ``FLAGS_monitor`` flips are honored.
+    """
+
+    def __init__(self, path, registry=None, set_monitor_flag=True):
+        super().__init__()
+        self.path = path
+        self._registry = registry
+        self._set_flag = set_monitor_flag
+        self._flag_scope = None
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..monitor import get_registry
+        return get_registry()
+
+    def _dump(self, extra):
+        try:
+            self._reg().dump_jsonl(self.path, extra=extra)
+        except OSError as e:          # telemetry must never kill training
+            print(f"MonitorCallback: dump to {self.path} failed: {e}")
+
+    def on_train_begin(self, logs=None):
+        if self._set_flag and self._flag_scope is None:
+            # flag_scope is the restore-capable override (keeps the
+            # explicitly-set bit); held open across the fit() run —
+            # Model.fit guarantees on_end("train") via its finally
+            from ..core.flags import flag_scope
+            self._flag_scope = flag_scope("monitor", True)
+            self._flag_scope.__enter__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._dump({"epoch": epoch})
+
+    def on_train_end(self, logs=None):
+        self._dump({"event": "train_end"})
+        if self._flag_scope is not None:
+            self._flag_scope.__exit__(None, None, None)
+            self._flag_scope = None
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

@@ -275,27 +275,33 @@ class Model:
                                           else [m.name()])])
 
         cbks.on_begin("train")
-        steps_done = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            for step, batch in enumerate(train_loader):
-                cbks.on_batch_begin("train", step, {})
-                batch = batch if isinstance(batch, (tuple, list)) else [batch]
-                *xs, y = batch
-                losses = self.train_batch(xs, [y])
-                logs = {"loss": losses[0], "step": step}
-                cbks.on_batch_end("train", step, logs)
-                steps_done += 1
-                if num_iters is not None and steps_done >= num_iters:
+        # on_end runs even when training dies mid-epoch (KeyboardInterrupt,
+        # OOM, a NaN-watchdog NonFiniteError): callbacks that acquire
+        # process state in on_begin — MonitorCallback's FLAGS_monitor
+        # flip, open files — must get their teardown
+        try:
+            steps_done = 0
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                for step, batch in enumerate(train_loader):
+                    cbks.on_batch_begin("train", step, {})
+                    batch = batch if isinstance(batch, (tuple, list)) else [batch]
+                    *xs, y = batch
+                    losses = self.train_batch(xs, [y])
+                    logs = {"loss": losses[0], "step": step}
+                    cbks.on_batch_end("train", step, logs)
+                    steps_done += 1
+                    if num_iters is not None and steps_done >= num_iters:
+                        break
+                cbks.on_epoch_end(epoch, logs if "logs" in dir() else {})
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, verbose=0)
+                if self.stop_training or (num_iters is not None and steps_done >= num_iters):
                     break
-            cbks.on_epoch_end(epoch, logs if "logs" in dir() else {})
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, verbose=0)
-            if self.stop_training or (num_iters is not None and steps_done >= num_iters):
-                break
-        cbks.on_end("train")
+        finally:
+            cbks.on_end("train")
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 import weakref
 from typing import Any, Callable, Dict, Optional
 
@@ -321,7 +322,8 @@ class TrainStep:
                  metrics_fn: Optional[Callable] = None, donate: bool = True,
                  mesh=None, data_spec=None, zero_axis: Optional[str] = None,
                  grad_accum_steps: Optional[int] = None,
-                 grad_accum_avg: Optional[bool] = None):
+                 grad_accum_avg: Optional[bool] = None,
+                 check_numerics=False):
         from ..distributed import env as dist_env
         self.layer = layer
         self.loss_fn = loss_fn
@@ -364,6 +366,23 @@ class TrainStep:
         self.step_count = 0
         self._jitted: Dict[Any, Callable] = {}
         self._donate = donate
+        # -- telemetry (paddle_tpu.monitor; docs/OBSERVABILITY.md) ---------
+        # check_numerics: opt-in eager NaN/Inf watchdog — the post-step
+        # loss check runs OUTSIDE the compiled program (XLA fusion
+        # untouched; contrast FLAGS_check_nan_inf, which compiles finite
+        # flags into the step). The post-mortem grads pass needs the
+        # PRE-update params/buffers alive after the step, so donation is
+        # off in this mode. Values: False | True/"raise" | "warn".
+        self._check_numerics = check_numerics
+        if check_numerics:
+            self._donate = False
+        self._kinds_compiled: set = set()
+        self._stats = {"compiles": 0, "recompiles": 0,
+                       "grad_accum_syncs": 0, "nonfinite_trips": 0}
+        from ..core.tensor import eager_cache_stats
+        from ..utils.compilation import compile_counts
+        self._cc0 = compile_counts()
+        self._ec0 = eager_cache_stats()
 
     # -- SPMD layout -------------------------------------------------------
     def _param_specs(self):
@@ -505,7 +524,137 @@ class TrainStep:
 
         return step
 
-    def _call_accum(self, flat, treedef, check):
+    # -- telemetry (paddle_tpu.monitor) ------------------------------------
+    def _note_compile(self, kind: str, mon: bool):
+        """A jit-cache miss: a new executable is about to be built. A miss
+        for a program KIND that already has a compiled entry is a
+        RECOMPILE (shape change, flag flip) — the event the scan-layer
+        work exists to eliminate, surfaced here so it can't regress
+        silently."""
+        st = self._stats
+        st["compiles"] += 1
+        recompile = kind in self._kinds_compiled
+        if recompile:
+            st["recompiles"] += 1
+        self._kinds_compiled.add(kind)
+        if mon:
+            from ..monitor import get_registry
+            reg = get_registry()
+            reg.counter("train_step_compiles_total",
+                        "TrainStep executable builds by program kind"
+                        ).inc(kind=kind)
+            if recompile:
+                reg.counter("train_step_recompiles_total",
+                            "TrainStep recompiles (new signature for an "
+                            "already-compiled program kind)").inc(kind=kind)
+
+    def _record_step_metrics(self, t_wall: float, dispatch_s: float,
+                             kind: str = "step"):
+        from ..monitor import get_registry
+        reg = get_registry()
+        reg.counter("train_step_steps_total",
+                    "TrainStep calls by program kind").inc(kind=kind)
+        reg.histogram("train_step_dispatch_seconds",
+                      "time for the jitted call to return (async XLA "
+                      "dispatch)").observe(dispatch_s, kind=kind)
+        reg.histogram("train_step_wall_seconds",
+                      "full TrainStep.__call__ wall time (host prep + "
+                      "dispatch)").observe(time.perf_counter() - t_wall,
+                                           kind=kind)
+
+    @contextlib.contextmanager
+    def _step_span(self, mon: bool, name: str = "TrainStep.step"):
+        """RecordEvent around the dispatch in monitor mode — steps appear
+        on host timelines next to the comm/op lanes."""
+        if not mon:
+            yield
+            return
+        from ..profiler import RecordEvent
+        with RecordEvent(name):
+            yield
+
+    def _watchdog(self, loss, prev_params, prev_buffers, key, flat,
+                  treedef, step_index: int, step_kind: str = "step"):
+        """check_numerics post-step check (eager, outside the compiled
+        step). Cost while healthy: ONE scalar readback per step (which
+        also synchronizes dispatch — this is a debugging mode). On a trip:
+        a grads-only diagnosis pass re-runs fwd+bwd at the PRE-update
+        state with the same RNG key and batch, naming the first (sorted)
+        non-finite gradient/parameter. ``step_kind`` disambiguates the
+        two step clocks: accum-only trips report the MICROSTEP index,
+        optimizer-update trips the step (optimizer) index."""
+        if bool(jnp.isfinite(loss).all()):
+            return
+        self._stats["nonfinite_trips"] += 1
+        from ..monitor import get_registry
+        from ..monitor.numerics import NonFiniteError, first_nonfinite
+        # the param scan needs no compilation — run it before (and
+        # independently of) the fallible grads re-trace
+        bad_param = bad_grad = None
+        try:
+            bad_param = first_nonfinite(prev_params)
+        except Exception:
+            pass
+        try:
+            sig = ("diag", _sig_of(flat)[0], treedef)
+            diag = self._jitted.get(sig)
+            if diag is None:
+                diag = jax.jit(self._loss_and_grads(treedef))
+                self._jitted[sig] = diag
+            (_dloss, _dbufs), grads = diag(prev_params, prev_buffers, key,
+                                           flat)
+            bad_grad = first_nonfinite(grads)
+        except Exception:
+            pass                      # diagnosis is best-effort
+        get_registry().counter(
+            "numerics_nonfinite_total",
+            "NaN/Inf watchdog trips by kind").inc(what="train_step")
+        parts = [f"non-finite loss at {step_kind} {step_index}"]
+        if bad_param is not None:
+            parts.append(f"parameter {bad_param!r} was already non-finite "
+                         "before this step")
+        if bad_grad is not None:
+            parts.append(f"first non-finite gradient: {bad_grad!r}")
+        msg = ("; ".join(parts)
+               + " (TrainStep check_numerics watchdog; the in-graph "
+               "variant is FLAGS_check_nan_inf)")
+        offender = bad_grad or bad_param or "loss"
+        if self._check_numerics == "warn":
+            import warnings
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+            return
+        raise NonFiniteError(msg, offender=offender, step=step_index)
+
+    def stats(self) -> dict:
+        """Telemetry snapshot since construction: our jit-entry builds
+        (``compiles``/``recompiles`` — a warm scan-layer GPT shows exactly
+        1 and 0), XLA backend-compile / persistent-cache / trace deltas
+        (process-wide window, via utils.compilation), eager op-cache hit
+        rates, and accumulation/watchdog counters. Plain-dict reads — no
+        device sync, callable every step."""
+        from ..core.tensor import eager_cache_stats
+        from ..utils.compilation import compile_counts
+        cc = compile_counts()
+        ec = eager_cache_stats()
+        d = dict(self._stats)
+        d.update(
+            steps=self.step_count,
+            microsteps=self._micro_count,
+            grad_accum_steps=self.grad_accum_steps,
+            backend_compiles=(cc["backend_compiles"]
+                              - self._cc0["backend_compiles"]),
+            persistent_cache_misses=(cc["cache_misses"]
+                                     - self._cc0["cache_misses"]),
+            jaxpr_traces=cc["jaxpr_traces"] - self._cc0["jaxpr_traces"],
+            eager_cache_hits=ec["hits"] - self._ec0["hits"],
+            eager_cache_misses=ec["misses"] - self._ec0["misses"],
+        )
+        seen = d["eager_cache_hits"] + d["eager_cache_misses"]
+        d["eager_cache_hit_rate"] = (d["eager_cache_hits"] / seen
+                                     if seen else None)
+        return d
+
+    def _call_accum(self, flat, treedef, check, mon, t_wall):
         """Gradient-merge path: k-1 accumulate-only microsteps, then one
         accumulate+update microstep."""
         if self._acc_grads is None:
@@ -513,11 +662,14 @@ class TrainStep:
                 jnp.zeros_like, self.params)
         key = make_rng("train_step")
         self._micro_count += 1
+        prev = ((self.params, self.buffers) if self._check_numerics
+                else None)
         is_update = self._micro_count % self.grad_accum_steps == 0
         if not is_update:
             sig = ("acc", _sig_of(flat)[0], treedef)
             jitted = self._jitted.get(sig)
             if jitted is None:
+                self._note_compile("accum", mon)
                 fn = self._make_accum_step(treedef)
                 # _donation_safe re-checked per compiled entry: the
                 # persistent cache may be enabled after construction
@@ -525,9 +677,18 @@ class TrainStep:
                                  if self._donate and _donation_safe()
                                  else ())
                 self._jitted[sig] = jitted
-            with _control_flow_guidance():
+            t0 = time.perf_counter() if mon else 0.0
+            with _control_flow_guidance(), self._step_span(
+                    mon, "TrainStep.accum_microstep"):
                 self.buffers, self._acc_grads, loss = jitted(
                     self.params, self.buffers, self._acc_grads, key, flat)
+            if mon:
+                self._record_step_metrics(t_wall,
+                                          time.perf_counter() - t0,
+                                          kind="accum")
+            if self._check_numerics:
+                self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
+                               self._micro_count, step_kind="microstep")
             return Tensor(loss)
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -535,13 +696,27 @@ class TrainStep:
         sig = ("apply", _sig_of(flat)[0], treedef, check)
         jitted = self._jitted.get(sig)
         if jitted is None:
+            self._note_compile("apply", mon)
             fn = self._make_apply_step(treedef, check_finite=check)
             jitted = jax.jit(fn, donate_argnums=(0, 2, 3)
                              if self._donate and _donation_safe() else ())
             self._jitted[sig] = jitted
-        with _control_flow_guidance():
+        t0 = time.perf_counter() if mon else 0.0
+        with _control_flow_guidance(), self._step_span(
+                mon, "TrainStep.grad_accum_sync"):
             out = jitted(self.params, self.buffers, self.opt_state,
                          self._acc_grads, lr, t, key, flat)
+        # the k-th microstep is the accumulation SYNC boundary: grads are
+        # folded into the optimizer here (reference: the gated update
+        # block of gradient_merge_optimizer.py)
+        self._stats["grad_accum_syncs"] += 1
+        if mon:
+            self._record_step_metrics(t_wall, time.perf_counter() - t0,
+                                      kind="apply")
+            from ..monitor import get_registry
+            get_registry().counter(
+                "train_step_grad_accum_syncs_total",
+                "gradient-accumulation optimizer-update boundaries").inc()
         if check:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss, flags) = out
@@ -553,19 +728,25 @@ class TrainStep:
         else:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss) = out
+        if self._check_numerics:
+            self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
+                           self.step_count)
         return Tensor(loss)
 
     def __call__(self, *batch):
         from ..core.flags import get_flag
+        mon = bool(get_flag("monitor"))
+        t_wall = time.perf_counter() if mon else 0.0
         raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         raw = self._place_batch(raw)
         flat, treedef = jax.tree_util.tree_flatten(raw)
         check = bool(get_flag("check_nan_inf"))
         if self.grad_accum_steps > 1:
-            return self._call_accum(flat, treedef, check)
+            return self._call_accum(flat, treedef, check, mon, t_wall)
         sig = (_sig_of(flat)[0], treedef, check)
         jitted = self._jitted.get(sig)
         if jitted is None:
+            self._note_compile("step", mon)
             fn = self._make_step(treedef, check_finite=check)
             donate = (0, 2) if self._donate and _donation_safe() else ()
             jitted = jax.jit(fn, donate_argnums=donate)
@@ -574,9 +755,14 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = make_rng("train_step")
-        with _control_flow_guidance():
+        prev = ((self.params, self.buffers) if self._check_numerics
+                else None)
+        t0 = time.perf_counter() if mon else 0.0
+        with _control_flow_guidance(), self._step_span(mon):
             out = jitted(self.params, self.buffers, self.opt_state, lr, t,
                          key, flat)
+        if mon:
+            self._record_step_metrics(t_wall, time.perf_counter() - t0)
         if check:
             self.params, self.buffers, self.opt_state, loss, flags = out
             bad = [k for k, ok in flags.items() if not bool(ok)]
@@ -586,6 +772,9 @@ class TrainStep:
                     f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
         else:
             self.params, self.buffers, self.opt_state, loss = out
+        if self._check_numerics:
+            self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
+                           self.step_count)
         return Tensor(loss)
 
     def sync_to_layer(self):

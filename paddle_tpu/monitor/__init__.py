@@ -1,0 +1,43 @@
+"""paddle_tpu.monitor — unified training telemetry.
+
+Four pillars (ISSUE 3 tentpole; see docs/OBSERVABILITY.md):
+
+1. a structured **metrics registry** (:mod:`.metrics`): thread-safe
+   Counter/Gauge/Histogram with labels, Prometheus text + append-only
+   JSONL export, a process-global default registry plus
+   :func:`scoped_registry` for tests;
+2. **step-time instrumentation** in :class:`~paddle_tpu.jit.to_static.
+   TrainStep` — ``TrainStep.stats()`` snapshots compiles/recompiles,
+   eager-cache hit rates and (under ``FLAGS_monitor``) per-step
+   wall/dispatch timings streamed into the registry;
+3. **collective tracing** (:mod:`paddle_tpu.distributed.collective`):
+   every eager collective records op/group/bytes/latency counters and a
+   host-timeline RecordEvent;
+4. the **NaN/Inf watchdog** (:mod:`.numerics`): eager post-step checks
+   that name the first offending parameter/gradient and step index,
+   AMP-GradScaler aware.
+
+The registry is always importable and writable; the HOT paths only write
+to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
+the write_count guard in tests/test_monitor.py).
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      get_registry, load_jsonl, scoped_registry)
+from .numerics import (NaNWatchdog, NonFiniteError, all_finite,  # noqa: F401
+                       check_numerics, first_nonfinite, nonfinite_entries)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "scoped_registry", "load_jsonl",
+    "NaNWatchdog", "NonFiniteError", "all_finite", "check_numerics",
+    "first_nonfinite", "nonfinite_entries",
+    "enabled",
+]
+
+
+def enabled() -> bool:
+    """True when ``FLAGS_monitor`` is set — hot paths consult this before
+    writing per-step samples into the registry."""
+    from ..core.flags import get_flag
+    return bool(get_flag("monitor"))

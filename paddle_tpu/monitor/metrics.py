@@ -1,0 +1,341 @@
+"""Structured metrics registry: Counter / Gauge / Histogram with labels.
+
+The framework-wide telemetry store (reference analogue: the per-op stat
+tables of platform/profiler.cc plus the monitoring counters scattered
+through fluid — here unified in one process-global registry, the way the
+reference's device_tracer aggregates everything the timeline needs).
+
+Design:
+- three metric kinds — Counter (monotonic), Gauge (set-to-value),
+  Histogram (bucketed observations with sum/count) — each supporting
+  free-form string labels (``reg.counter("comm_bytes_total").inc(4096,
+  op="all_reduce", group="dp")``);
+- one process-global default registry (:func:`get_registry`) plus
+  :func:`scoped_registry` for tests that need isolation;
+- two export formats: Prometheus text exposition
+  (:meth:`MetricsRegistry.to_prometheus`) and append-only JSONL
+  (:meth:`MetricsRegistry.dump_jsonl`, rendered by
+  ``tools/monitor_report.py``);
+- every mutation bumps :attr:`MetricsRegistry.write_count`, which is how
+  the zero-overhead guarantee of the monitor-off hot path is pinned in
+  tests (no per-step registry writes unless ``FLAGS_monitor`` is on).
+
+All operations are thread-safe (one RLock per registry; eager-op threads,
+the DataLoader workers, and the async checkpoint thread may all write).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "scoped_registry", "load_jsonl",
+]
+
+# Prometheus' default latency buckets (seconds), the right shape for both
+# host-side step timings (ms..s) and eager dispatch (sub-ms).
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt_labels(key: _LabelKey, extra: Optional[Tuple[Tuple[str, str], ...]]
+                = None) -> str:
+    items = list(key) + list(extra or ())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry"):
+        self.name = name
+        self.help = help
+        self._reg = registry
+        self._lock = registry._lock
+        self._series: Dict[_LabelKey, Any] = {}
+
+    def samples(self) -> List[Tuple[Dict[str, str], Any]]:
+        """[(labels_dict, value), ...] — value is a float for counter/gauge,
+        a dict for histograms."""
+        with self._lock:
+            return [(dict(k), self._export(v))
+                    for k, v in self._series.items()]
+
+    def _export(self, v):
+        return v
+
+    def labels_seen(self) -> List[Dict[str, str]]:
+        with self._lock:
+            return [dict(k) for k in self._series]
+
+
+class Counter(_Metric):
+    """Monotonic counter (Prometheus semantics: only goes up)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError(f"counter {self.name}: negative increment "
+                             f"{value} (use a Gauge)")
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+            self._reg._write_count += 1
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    """Set-to-current-value metric (queue depth, loss scale, cache size)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+            self._reg._write_count += 1
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+            self._reg._write_count += 1
+
+    def dec(self, value: float = 1.0, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Bucketed observations with cumulative sum/count per label set."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, registry, buckets=None):
+        super().__init__(name, help, registry)
+        bs = tuple(sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
+        if not bs:
+            raise ValueError(f"histogram {self.name}: needs >= 1 bucket")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            st = self._series.get(k)
+            if st is None:
+                st = {"counts": [0] * len(self.buckets), "sum": 0.0,
+                      "count": 0}
+                self._series[k] = st
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st["counts"][i] += 1
+                    break
+            st["sum"] += float(value)
+            st["count"] += 1
+            self._reg._write_count += 1
+
+    def _export(self, st) -> dict:
+        # cumulative-`le` form, the shape both exporters serialize
+        cum, acc = [], 0
+        for b, c in zip(self.buckets, st["counts"]):
+            acc += c
+            cum.append([b, acc])
+        return {"count": st["count"], "sum": st["sum"], "buckets": cum}
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            return int(st["count"]) if st else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            return float(st["sum"]) if st else 0.0
+
+    def mean(self, **labels) -> float:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            return float(st["sum"] / st["count"]) if st and st["count"] \
+                else 0.0
+
+
+class MetricsRegistry:
+    """Named collection of metrics with get-or-create accessors."""
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._write_count = 0
+
+    # -- accessors ---------------------------------------------------------
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> _Metric:
+        if self.namespace:
+            name = f"{self.namespace}_{name}"
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, self, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    @property
+    def write_count(self) -> int:
+        """Monotonic count of metric mutations — the overhead-guard probe:
+        the monitor-off hot path must leave this unchanged per step."""
+        with self._lock:
+            return self._write_count
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, dict]:
+        """{name: {type, help, samples: [(labels, value), ...]}} — values
+        are plain python (floats / histogram dicts), safe to json-encode."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: {"type": m.kind, "help": m.help,
+                         "samples": m.samples()} for m in metrics}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for labels, value in m.samples():
+                key = _label_key(labels)
+                if m.kind == "histogram":
+                    for le, cum in value["buckets"]:
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_fmt_labels(key, (('le', repr(float(le))),))}"
+                            f" {cum}")
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(key, (('le', '+Inf'),))}"
+                        f" {value['count']}")
+                    lines.append(f"{m.name}_sum{_fmt_labels(key)} "
+                                 f"{value['sum']}")
+                    lines.append(f"{m.name}_count{_fmt_labels(key)} "
+                                 f"{value['count']}")
+                else:
+                    lines.append(f"{m.name}{_fmt_labels(key)} {value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump_jsonl(self, path: str, extra: Optional[dict] = None) -> str:
+        """Append one JSON line per (metric, label-set) sample.
+
+        Append-only by design: successive dumps (per epoch, per bench run)
+        accumulate; readers take the newest sample per (name, labels) —
+        see tools/monitor_report.py. ``extra`` keys (epoch, tag, source)
+        are merged into every line."""
+        ts = time.time()
+        base = dict(extra or {})
+        with open(path, "a") as f:
+            for name, info in self.snapshot().items():
+                for labels, value in info["samples"]:
+                    line = dict(base, ts=round(ts, 3), name=name,
+                                type=info["type"], labels=labels)
+                    if info["type"] == "histogram":
+                        line.update(count=value["count"], sum=value["sum"],
+                                    buckets=value["buckets"])
+                    else:
+                        line["value"] = value
+                    f.write(json.dumps(line) + "\n")
+        return path
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse a registry JSONL dump; skips malformed lines (a crashed
+    writer must not make the whole record unreadable)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "name" in d:
+                out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Default + scoped registries
+# ---------------------------------------------------------------------------
+
+_default_registry = MetricsRegistry()
+_registry_stack: List[MetricsRegistry] = []
+
+
+def get_registry() -> MetricsRegistry:
+    """The active registry: the innermost :func:`scoped_registry` if one is
+    open, else the process-global default."""
+    return _registry_stack[-1] if _registry_stack else _default_registry
+
+
+@contextlib.contextmanager
+def scoped_registry(registry: Optional[MetricsRegistry] = None) \
+        -> Iterator[MetricsRegistry]:
+    """Route :func:`get_registry` to a fresh (or given) registry for the
+    with-block — test isolation without touching the process-global one."""
+    reg = registry if registry is not None else MetricsRegistry()
+    _registry_stack.append(reg)
+    try:
+        yield reg
+    finally:
+        _registry_stack.pop()

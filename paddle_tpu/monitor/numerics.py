@@ -1,0 +1,171 @@
+"""NaN/Inf watchdog: eager post-step numerics checks.
+
+Reference analogue: FLAGS_check_nan_inf + nan_inf_utils_detail.cu — the
+reference scans every op's outputs on device. The repo already has that
+in-graph form (``FLAGS_check_nan_inf`` compiles per-gradient finite flags
+INTO the train step, jit/to_static.py). This module is the complementary
+*eager* watchdog: it runs OUTSIDE the compiled step, so XLA fusion and
+the compiled program are untouched — zero cost until something trips,
+then a post-mortem names the first offending parameter/gradient and the
+step index.
+
+Used by ``TrainStep(check_numerics=...)`` (which re-runs a grads-only
+diagnosis pass at the pre-update parameters on a trip) and usable
+directly on eager training loops via :func:`check_numerics` /
+:class:`NaNWatchdog`.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional
+
+__all__ = ["NonFiniteError", "all_finite", "nonfinite_entries",
+           "first_nonfinite", "check_numerics", "NaNWatchdog"]
+
+
+class NonFiniteError(RuntimeError):
+    """Raised when the watchdog finds a NaN/Inf; carries the offender name
+    and the step index for programmatic handling."""
+
+    def __init__(self, message: str, offender: Optional[str] = None,
+                 step: Optional[int] = None):
+        super().__init__(message)
+        self.offender = offender
+        self.step = step
+
+
+def _raw(v):
+    return v._data if hasattr(v, "_data") else v
+
+
+def _is_finite(arr) -> bool:
+    import jax.numpy as jnp
+    a = _raw(arr)
+    if not hasattr(a, "dtype"):
+        import math
+        return math.isfinite(a)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        return True
+    return bool(jnp.isfinite(a).all())
+
+
+def all_finite(tree: Dict[str, Any]) -> bool:
+    """One fused device check over a name->array dict: True iff every
+    float entry is finite. O(1) host readbacks (single stacked reduction),
+    the fast pre-check before the per-name walk."""
+    import jax
+    import jax.numpy as jnp
+    flags = [jnp.isfinite(_raw(v)).all() for v in tree.values()
+             if hasattr(_raw(v), "dtype")
+             and jnp.issubdtype(_raw(v).dtype, jnp.floating)]
+    if not flags:
+        return True
+    return bool(jax.numpy.stack(flags).all())
+
+
+def nonfinite_entries(tree: Dict[str, Any]) -> List[str]:
+    """Names (sorted) of entries containing any NaN/Inf."""
+    return [k for k in sorted(tree) if not _is_finite(tree[k])]
+
+
+def first_nonfinite(tree: Dict[str, Any]) -> Optional[str]:
+    """First (sorted-name) entry with a non-finite value, or None.
+
+    Name order, not op order: eager post-step checks see the final pytree,
+    not the op stream, so "first" is deterministic by name — enough to
+    point at the offending parameter/gradient."""
+    for k in sorted(tree):
+        if not _is_finite(tree[k]):
+            return k
+    return None
+
+
+def check_numerics(tree: Dict[str, Any], step: Optional[int] = None,
+                   what: str = "tensor", action: str = "raise",
+                   registry=None) -> Optional[str]:
+    """Check a name->array dict; on a non-finite entry record a
+    ``numerics_nonfinite_total{what=...}`` counter and raise
+    :class:`NonFiniteError` (``action="raise"``) or warn
+    (``action="warn"``). Returns the offender name (None when clean)."""
+    if all_finite(tree):
+        return None
+    offender = first_nonfinite(tree)
+    from .metrics import get_registry
+    reg = registry if registry is not None else get_registry()
+    reg.counter("numerics_nonfinite_total",
+                "NaN/Inf watchdog trips by kind").inc(what=what)
+    at = f" at step {step}" if step is not None else ""
+    msg = (f"NaN/Inf detected{at}: first non-finite {what} is "
+           f"{offender!r} (check_numerics watchdog; see "
+           f"docs/OBSERVABILITY.md)")
+    if action == "warn":
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
+        return offender
+    raise NonFiniteError(msg, offender=offender, step=step)
+
+
+class NaNWatchdog:
+    """Stateful watchdog for eager training loops.
+
+    ::
+
+        dog = NaNWatchdog()               # or action="warn"
+        for step, batch in enumerate(loader):
+            loss = loss_fn(model(batch))
+            loss.backward()
+            dog.check_loss(loss, step)
+            dog.check_grads(model, step, scaler=scaler)
+            opt.step(); opt.clear_grad()
+
+    AMP integration: when an ENABLED :class:`~paddle_tpu.amp.GradScaler`
+    is passed, non-finite gradients are the scaler's to handle — it will
+    flag them at ``unscale_`` (non-finiteness survives unscaling) and
+    SKIP the optimizer step, which is dynamic loss scaling working as
+    designed. The watchdog records the trip (labelled
+    ``handled="amp_skip"``) but does not raise, regardless of whether
+    ``unscale_`` has run yet this iteration.
+    """
+
+    def __init__(self, action: str = "raise", registry=None):
+        self.action = action
+        self._registry = registry
+        self.trips = 0
+
+    def _reg(self):
+        from .metrics import get_registry
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    def check_loss(self, loss, step: Optional[int] = None) -> Optional[str]:
+        if _is_finite(loss):
+            return None
+        self.trips += 1
+        return check_numerics({"loss": loss}, step=step, what="loss",
+                              action=self.action, registry=self._reg())
+
+    def check_grads(self, layer_or_grads, step: Optional[int] = None,
+                    scaler=None) -> Optional[str]:
+        """``layer_or_grads``: a Layer (uses ``p.grad`` of named params) or
+        a name->array dict."""
+        if hasattr(layer_or_grads, "named_parameters"):
+            grads = {k: p.grad for k, p in layer_or_grads.named_parameters()
+                     if p.grad is not None}
+        else:
+            grads = dict(layer_or_grads)
+        if all_finite(grads):
+            return None
+        self.trips += 1
+        offender = first_nonfinite(grads)
+        if scaler is not None and scaler.is_enable():
+            # non-finiteness is invariant under unscaling (inf/k == inf),
+            # so an ENABLED scaler is guaranteed to flag these grads at
+            # unscale_ and skip the step — whether or not unscale_ has
+            # run yet this iteration. Count it, don't kill the run.
+            self._reg().counter(
+                "numerics_nonfinite_total",
+                "NaN/Inf watchdog trips by kind").inc(
+                    what="grad", handled="amp_skip")
+            return offender
+        return check_numerics(grads, step=step, what="grad",
+                              action=self.action, registry=self._reg())

@@ -30,7 +30,7 @@ import threading
 
 import jax
 
-__all__ = ["CompileCounter", "compile_counts"]
+__all__ = ["CompileCounter", "compile_counts", "publish_compile_counts"]
 
 _LOCK = threading.Lock()
 _COUNTS = {"backend_compiles": 0, "cache_misses": 0, "jaxpr_traces": 0}
@@ -67,6 +67,29 @@ def compile_counts() -> dict:
     _install()
     with _LOCK:
         return dict(_COUNTS)
+
+
+def publish_compile_counts(registry=None) -> dict:
+    """Bridge the process-lifetime compile counters into the monitor
+    metrics registry as gauges (``jax_backend_compiles``,
+    ``jax_cache_misses``, ``jax_jaxpr_traces``, plus nn.scan's
+    ``scan_body_traces``/``scan_calls``) — called by bench.py before its
+    JSONL dump so perf records carry recompile counts. Returns the raw
+    counts dict."""
+    counts = compile_counts()
+    try:
+        from ..nn.scan import SCAN_STATS
+        counts = dict(counts, scan_body_traces=SCAN_STATS["body_traces"],
+                      scan_calls=SCAN_STATS["scan_calls"])
+    except Exception:
+        pass
+    from ..monitor import get_registry
+    reg = registry if registry is not None else get_registry()
+    for k, v in counts.items():
+        name = k if k.startswith("scan_") else "jax_" + k
+        reg.gauge(name, "process-lifetime compile/trace counter "
+                        "(utils.compilation)").set(v)
+    return counts
 
 
 class CompileCounter:

@@ -1,0 +1,443 @@
+"""Unified training telemetry (ISSUE 3 tentpole): metrics registry
+round-trip, TrainStep.stats() compile pins, collective byte/latency
+counters, the NaN/Inf watchdog, and the monitor-off overhead guard."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.monitor import (MetricsRegistry, NonFiniteError,
+                                scoped_registry)
+from paddle_tpu.optimizer import SGD, AdamW
+
+
+def _mse(layer, x, y):
+    return ((layer(x) - y) ** 2).mean()
+
+
+def _linear_step(check_numerics=False, lr=0.1):
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    opt = SGD(learning_rate=lr, parameters=m.parameters())
+    return TrainStep(m, _mse, opt, check_numerics=check_numerics)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(8, 4).astype(np.float32),
+            rng.rand(8, 2).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests")
+    c.inc()
+    c.inc(2, route="a")
+    assert c.value() == 1
+    assert c.value(route="a") == 2
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(5.555)
+    assert h.mean() == pytest.approx(5.555 / 4)
+    # kind mismatch on an existing name is an error, not a silent clobber
+    with pytest.raises(TypeError):
+        reg.gauge("req_total")
+
+
+def test_registry_prometheus_text_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("comm_bytes_total", "bytes").inc(4096, op="all_reduce",
+                                                 group="dp")
+    reg.histogram("step_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    text = reg.to_prometheus()
+    assert "# TYPE comm_bytes_total counter" in text
+    assert 'comm_bytes_total{group="dp",op="all_reduce"} 4096.0' in text
+    assert "# TYPE step_seconds histogram" in text
+    assert 'step_seconds_bucket{le="+Inf"} 1' in text
+    assert "step_seconds_sum 0.5" in text
+    assert "step_seconds_count 1" in text
+    # cumulative bucket semantics
+    assert 'step_seconds_bucket{le="1.0"} 1' in text
+    assert 'step_seconds_bucket{le="0.1"} 0' in text
+
+
+def test_registry_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    reg = MetricsRegistry()
+    reg.counter("x_total").inc(3, op="a")
+    reg.gauge("g").set(2.5)
+    reg.histogram("h_seconds", buckets=(0.1,)).observe(0.05)
+    reg.dump_jsonl(path, extra={"epoch": 1})
+    reg.counter("x_total").inc(1, op="a")          # append-only: 2nd dump
+    reg.dump_jsonl(path, extra={"epoch": 2})
+    rows = monitor.load_jsonl(path)
+    assert all(json.dumps(r) for r in rows)        # valid json lines
+    x_rows = [r for r in rows if r["name"] == "x_total"]
+    assert [r["value"] for r in x_rows] == [3.0, 4.0]
+    assert [r["epoch"] for r in x_rows] == [1, 2]
+    h = [r for r in rows if r["name"] == "h_seconds"][-1]
+    assert h["count"] == 1 and h["sum"] == pytest.approx(0.05)
+    g = [r for r in rows if r["name"] == "g"][-1]
+    assert g["value"] == 2.5 and g["type"] == "gauge"
+
+
+def test_scoped_registry_isolates_default():
+    base = monitor.get_registry()
+    with scoped_registry() as reg:
+        assert monitor.get_registry() is reg
+        reg.counter("scoped_total").inc()
+        with scoped_registry() as inner:
+            assert monitor.get_registry() is inner
+        assert monitor.get_registry() is reg
+    assert monitor.get_registry() is base
+    assert base.get("scoped_total") is None
+
+
+# ---------------------------------------------------------------------------
+# TrainStep telemetry
+# ---------------------------------------------------------------------------
+
+def test_monitor_off_adds_no_registry_writes():
+    """The overhead guard: with FLAGS_monitor unset (default) the train
+    step hot path performs ZERO registry writes."""
+    step = _linear_step()
+    x, y = _batch()
+    with scoped_registry() as reg:
+        before = reg.write_count
+        for _ in range(4):
+            step(x, y)
+        assert reg.write_count == before
+        assert reg.names() == []
+
+
+def test_train_step_stats_one_compile_scan_gpt():
+    """Acceptance pin: N warm steps of a scan-layer GPT = exactly 1
+    compile, 0 recompiles."""
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       GPTPretrainingCriterion, gpt_tiny)
+    paddle.seed(3)
+    model = GPTForPretraining(gpt_tiny(num_layers=3, scan_layers=True))
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels)
+
+    step = TrainStep(model, loss_fn,
+                     AdamW(learning_rate=1e-3,
+                           parameters=model.parameters()))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (2, 16)).astype(np.int32)
+    labels = rng.randint(0, 256, (2, 16)).astype(np.int32)
+    for _ in range(4):
+        loss = step(ids, labels)
+    assert np.isfinite(float(loss))
+    st = step.stats()
+    assert st["compiles"] == 1
+    assert st["recompiles"] == 0
+    assert st["steps"] == 4
+    assert st["nonfinite_trips"] == 0
+
+
+def test_train_step_recompile_detected_on_shape_change():
+    step = _linear_step()
+    x, y = _batch()
+    step(x, y)
+    step(x[:4], y[:4])                      # new signature, same kind
+    st = step.stats()
+    assert st["compiles"] == 2
+    assert st["recompiles"] == 1
+
+
+def test_train_step_monitor_on_records_timings():
+    step = _linear_step()
+    x, y = _batch()
+    step(x, y)                              # compile outside the window
+    with scoped_registry() as reg:
+        with flag_scope("monitor", True):
+            for _ in range(3):
+                step(x, y)
+        assert reg.counter("train_step_steps_total").value(kind="step") == 3
+        h = reg.histogram("train_step_dispatch_seconds")
+        assert h.count(kind="step") == 3
+        assert reg.histogram("train_step_wall_seconds").count(kind="step") \
+            == 3
+        # and flipping the flag off stops the stream
+        before = reg.write_count
+        step(x, y)
+        assert reg.write_count == before
+
+
+def test_grad_accum_sync_boundary_counted():
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.1, parameters=m.parameters())
+    step = TrainStep(m, _mse, opt, grad_accum_steps=3)
+    x, y = _batch()
+    with scoped_registry() as reg:
+        with flag_scope("monitor", True):
+            for _ in range(6):              # two full accumulation windows
+                step(x, y)
+        assert reg.counter("train_step_grad_accum_syncs_total").value() == 2
+        assert reg.counter("train_step_steps_total").value(kind="accum") == 4
+        assert reg.counter("train_step_steps_total").value(kind="apply") == 2
+    st = step.stats()
+    assert st["grad_accum_syncs"] == 2
+    assert st["microsteps"] == 6
+    assert st["steps"] == 2
+
+
+# ---------------------------------------------------------------------------
+# collective tracing
+# ---------------------------------------------------------------------------
+
+def test_eager_all_reduce_records_bytes_and_latency():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1, 2, 3])
+    x = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+    labels = dict(op="all_reduce", group=g.axis_name, nranks=4)
+    with scoped_registry() as reg:
+        out = C.all_reduce(x, group=g)           # cold: builds shard_map
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.asarray(x).sum(axis=0))
+        C.all_reduce(x, group=g)                 # warm dispatch
+        assert reg.counter("comm_ops_total").value(**labels) == 2
+        assert reg.counter("comm_bytes_total").value(**labels) \
+            == 2 * x.nbytes
+        # compile-inclusive first call lands in its own histogram so the
+        # dispatch-latency series is not skewed by trace+compile time
+        cold = reg.histogram("comm_cold_dispatch_seconds")
+        assert cold.count(**labels) == 1
+        warm = reg.histogram("comm_latency_seconds")
+        assert warm.count(**labels) == 1
+        assert warm.sum(**labels) > 0
+
+
+def test_eager_broadcast_and_alltoall_traced():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1])
+    with scoped_registry() as reg:
+        C.broadcast(jnp.ones((2, 3), jnp.float32), src=0, group=g)
+        C.alltoall(jnp.ones((2, 2, 3), jnp.float32), group=g)
+        ops = {lab["op"] for lab, _ in
+               reg.counter("comm_ops_total").samples()}
+        assert {"broadcast", "alltoall"} <= ops
+
+
+def test_traced_collectives_do_not_record():
+    """Inside jit/shard_map the compiler owns scheduling — the eager
+    tracer must not log trace-time pseudo-latencies."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.distributed import collective as C, env
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("world",))
+    g = C.get_group(0)
+
+    with scoped_registry() as reg:
+        def body(x):
+            return C.all_reduce(x, group=g)
+
+        f = jax.jit(env.shard_map(body, mesh=mesh, in_specs=P("world"),
+                                  out_specs=P("world"), check_vma=False))
+        with env.axes_bound("world"):
+            f(jnp.ones((4, 2), jnp.float32))
+        assert reg.get("comm_ops_total") is None
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_names_first_nonfinite_gradient():
+    step = _linear_step(check_numerics=True)
+    x, y = _batch()
+    step(x, y)
+    step(x, y)
+    xbad = x.copy()
+    xbad[0, 0] = np.inf
+    with pytest.raises(NonFiniteError) as ei:
+        step(xbad, y)
+    # sorted-name first offender of Linear(4,2) grads is 'bias'
+    assert ei.value.offender == "bias"
+    assert ei.value.step == 3
+    assert "step 3" in str(ei.value)
+    assert "first non-finite gradient: 'bias'" in str(ei.value)
+    assert step.stats()["nonfinite_trips"] == 1
+
+
+def test_watchdog_warn_mode_continues():
+    step = _linear_step(check_numerics="warn")
+    x, y = _batch()
+    step(x, y)
+    xbad = x.copy()
+    xbad[0, 0] = np.nan
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        step(xbad, y)
+    # training object is still usable afterwards — and the watchdog keeps
+    # flagging that the NaN update poisoned the parameters
+    with pytest.warns(RuntimeWarning,
+                      match="already non-finite before this step"):
+        loss = step(x, y)
+    assert loss is not None
+
+
+def test_watchdog_healthy_run_never_trips():
+    step = _linear_step(check_numerics=True)
+    x, y = _batch()
+    for _ in range(3):
+        step(x, y)
+    assert step.stats()["nonfinite_trips"] == 0
+
+
+def test_numerics_helpers():
+    tree = {"a": np.ones(3, np.float32),
+            "c": np.array([1.0, np.nan], np.float32),
+            "b": np.array([np.inf], np.float32),
+            "ints": np.array([1, 2], np.int32)}
+    assert not monitor.all_finite(tree)
+    assert monitor.first_nonfinite(tree) == "b"
+    assert monitor.nonfinite_entries(tree) == ["b", "c"]
+    assert monitor.all_finite({"a": np.ones(2, np.float32)})
+    assert monitor.first_nonfinite({"a": np.ones(2, np.float32)}) is None
+    with scoped_registry() as reg:
+        with pytest.raises(NonFiniteError) as ei:
+            monitor.check_numerics(tree, step=5, what="grad")
+        assert ei.value.offender == "b" and ei.value.step == 5
+        assert reg.counter("numerics_nonfinite_total").value(what="grad") \
+            == 1
+
+
+def test_watchdog_amp_scaler_skip_integration():
+    """A GradScaler-skipped step is dynamic loss scaling working: the
+    watchdog records it (handled=amp_skip) but does not raise; the scaler
+    counts the skip in the registry."""
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(1)
+    m = nn.Linear(3, 1)
+    opt = SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+    dog = monitor.NaNWatchdog()
+    x = paddle.to_tensor(np.array([[1.0, np.inf, 0.0]], np.float32))
+    y = paddle.to_tensor(np.array([[1.0]], np.float32))
+    with scoped_registry() as reg:
+        loss = scaler.scale(((m(x) - y) ** 2).mean())
+        loss.backward()
+        scaler.unscale_(opt)
+        assert scaler.found_inf
+        offender = dog.check_grads(m, step=0, scaler=scaler)
+        assert offender is not None          # named, not raised
+        scaler.step(opt)
+        scaler.update()
+        assert scaler.skip_count == 1
+        assert reg.counter("amp_skipped_steps_total").value() == 1
+        assert reg.counter("numerics_nonfinite_total").value(
+            what="grad", handled="amp_skip") == 1
+    opt.clear_grad()
+
+
+# ---------------------------------------------------------------------------
+# LocalSGD sync boundaries
+# ---------------------------------------------------------------------------
+
+def test_localsgd_sync_boundary_counted():
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        LocalSGDTrainStep)
+    paddle.seed(5)
+    m = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.05, parameters=m.parameters())
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    step = LocalSGDTrainStep(m, _mse, opt, mesh, k_steps=2, axis="dp")
+    x, y = _batch()
+    with scoped_registry() as reg:
+        with flag_scope("monitor", True):
+            for _ in range(4):
+                step(x, y)
+        assert reg.counter("localsgd_syncs_total").value(axis="dp") == 2
+        assert reg.gauge("localsgd_k_steps").value(axis="dp") == 2
+    st = step.stats()
+    assert st["localsgd_syncs"] == 2
+    assert st["local_steps"] == 4
+    assert st["num_replicas"] == 2
+
+
+# ---------------------------------------------------------------------------
+# hapi MonitorCallback + report tool
+# ---------------------------------------------------------------------------
+
+def test_monitor_callback_streams_jsonl(tmp_path):
+    from paddle_tpu.hapi.callbacks import MonitorCallback
+    from paddle_tpu.core.flags import get_flag
+    path = str(tmp_path / "train.jsonl")
+    with scoped_registry() as reg:
+        reg.counter("seen_total").inc()
+        cb = MonitorCallback(path)
+        cb.on_train_begin()
+        assert get_flag("monitor") is True   # callback turns telemetry on
+        cb.on_epoch_end(0)
+        reg.counter("seen_total").inc()
+        cb.on_epoch_end(1)
+        cb.on_train_end()
+    assert get_flag("monitor") is False      # restored after training
+    rows = monitor.load_jsonl(path)
+    epochs = [r.get("epoch") for r in rows if r["name"] == "seen_total"]
+    assert epochs[:2] == [0, 1]
+    assert any(r.get("event") == "train_end" for r in rows)
+    values = [r["value"] for r in rows if r["name"] == "seen_total"]
+    assert values == [1.0, 2.0, 2.0]
+
+
+def test_monitor_report_renders_tables(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "monitor_report", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "monitor_report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    path = str(tmp_path / "bench.jsonl")
+    reg = MetricsRegistry()
+    reg.counter("comm_bytes_total").inc(1 << 20, op="all_reduce",
+                                        group="dp", nranks=4)
+    reg.counter("comm_ops_total").inc(8, op="all_reduce", group="dp",
+                                      nranks=4)
+    reg.histogram("comm_latency_seconds").observe(
+        0.002, op="all_reduce", group="dp", nranks=4)
+    reg.histogram("train_step_dispatch_seconds").observe(0.01, kind="step")
+    reg.counter("train_step_recompiles_total").inc(kind="step")
+    reg.gauge("jax_backend_compiles").set(17)
+    reg.dump_jsonl(path)
+    out = report.render(monitor.load_jsonl(path), top=5)
+    assert "Slowest events" in out
+    assert "train_step_dispatch_seconds" in out
+    assert "Compile / trace counters" in out
+    assert "jax_backend_compiles" in out
+    assert "Collectives" in out
+    assert "1.0 MiB" in out
+    assert "train_step_recompiles_total" in out
+    # CLI entry point works end-to-end
+    assert report.main([path]) == 0
+    assert report.main([]) == 2

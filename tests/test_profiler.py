@@ -1,0 +1,276 @@
+"""Profiler shim coverage (ISSUE 3 satellite — none existed before):
+RecordEvent aggregation, chrome-trace export validity, the
+make_scheduler state machine, Profiler windows/on_trace_ready, summary
+sorting, and the profile_train_step keys."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler as prof
+from paddle_tpu.optimizer import SGD
+
+
+@pytest.fixture(autouse=True)
+def _profiler_reset():
+    """Every test starts and ends with the profiler inactive."""
+    yield
+    prof.stop_profiler()
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent aggregation + summary
+# ---------------------------------------------------------------------------
+
+def test_record_event_aggregation():
+    prof.start_profiler()
+    with prof.RecordEvent("outer"):
+        with prof.RecordEvent("inner"):
+            pass
+        with prof.RecordEvent("inner"):
+            pass
+    prof.stop_profiler()
+    table = prof.summary()
+    assert "outer" in table and "inner" in table
+    inner = [ln for ln in table.splitlines() if ln.startswith("inner")][0]
+    assert inner.split()[1] == "2"          # calls column
+    outer = [ln for ln in table.splitlines() if ln.startswith("outer")][0]
+    assert outer.split()[1] == "1"
+
+
+def test_record_event_ignored_when_inactive():
+    prof.start_profiler()
+    prof.stop_profiler()
+    baseline = prof.summary()
+    with prof.RecordEvent("ghost"):
+        pass
+    assert "ghost" not in prof.summary()
+    assert prof.summary() == baseline
+
+
+def test_op_hook_bounded_when_inactive():
+    """Satellite pin: _op_hook must not leak events/timeline entries when
+    the profiler was never started (long eager runs)."""
+    prof.start_profiler()
+    prof.stop_profiler()
+    n_events = len(prof._events)
+    n_timeline = len(prof._timeline)
+    prof._op_hook("leaky_op", 0.001)
+    assert len(prof._events) == n_events
+    assert len(prof._timeline) == n_timeline
+
+
+def test_summary_sorting_keys():
+    prof.start_profiler()
+    import time
+    with prof.RecordEvent("slow_once"):
+        time.sleep(0.02)
+    for _ in range(5):
+        with prof.RecordEvent("fast_many"):
+            pass
+    prof.stop_profiler()
+    by_total = prof.summary(sorted_by="total").splitlines()
+    assert by_total[1].startswith("slow_once")
+    by_calls = prof.summary(sorted_by="calls").splitlines()
+    assert by_calls[1].startswith("fast_many")
+    by_avg = prof.summary(sorted_by="avg").splitlines()
+    assert by_avg[1].startswith("slow_once")
+    with pytest.raises(ValueError):
+        prof.summary(sorted_by="nope")
+
+
+def test_stop_profiler_writes_profile_path(tmp_path):
+    path = str(tmp_path / "profile.txt")
+    prof.start_profiler()
+    with prof.RecordEvent("evt"):
+        pass
+    prof.stop_profiler(sorted_key="calls", profile_path=path)
+    text = open(path).read()
+    assert "Event" in text and "evt" in text
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_is_valid_json(tmp_path):
+    path = str(tmp_path / "trace.json")
+    prof.start_profiler()
+    with prof.RecordEvent("step"):
+        with prof.RecordEvent("matmul"):
+            pass
+    prof.stop_profiler()
+    out = prof.export_chrome_tracing(path)
+    assert out == path
+    with open(path) as f:
+        doc = json.load(f)                   # JSON loads
+    events = doc["traceEvents"]
+    assert len(events) >= 2
+    names = {e["name"] for e in events}
+    assert {"step", "matmul"} <= names
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0
+
+
+def test_chrome_trace_handler_factory(tmp_path):
+    d = str(tmp_path / "traces")
+    handler = prof.export_chrome_tracing(d, worker_name="w0")
+    assert callable(handler)
+    p = prof.Profiler(scheduler=prof.make_scheduler(closed=0, ready=0,
+                                                    record=2, repeat=1),
+                      on_trace_ready=handler, timer_only=True)
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("tick"):
+            pass
+        p.step()
+    p.stop()
+    files = os.listdir(d)
+    assert files == ["w0_chrome_trace_1.json"]
+    with open(os.path.join(d, files[0])) as f:
+        assert "traceEvents" in json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# scheduler state machine
+# ---------------------------------------------------------------------------
+
+def test_make_scheduler_state_sequence():
+    S = prof.ProfilerState
+    sch = prof.make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                              skip_first=2)
+    states = [sch(i) for i in range(12)]
+    assert states == [
+        S.CLOSED, S.CLOSED,                              # skip_first
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 1
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 2
+        S.CLOSED, S.CLOSED,                              # repeat exhausted
+    ]
+    # repeat=0 cycles forever
+    sch2 = prof.make_scheduler(closed=0, ready=0, record=1)
+    assert [sch2(i) for i in range(3)] == [S.RECORD_AND_RETURN] * 3
+    with pytest.raises(ValueError):
+        prof.make_scheduler(closed=1, ready=0, record=0)
+    with pytest.raises(ValueError):
+        prof.make_scheduler(closed=-1, ready=0, record=1)
+
+
+def test_profiler_scheduler_windows_and_on_trace_ready():
+    ready_steps = []
+    p = prof.Profiler(
+        scheduler=prof.make_scheduler(closed=1, ready=1, record=2,
+                                      repeat=2),
+        on_trace_ready=lambda pr: ready_steps.append(pr.step_num),
+        timer_only=True)
+    p.start()
+    assert p.state == prof.ProfilerState.CLOSED
+    seen_states = []
+    for _ in range(10):
+        with prof.RecordEvent("tick"):
+            pass
+        seen_states.append(p.state)
+        p.step()
+    p.stop()
+    assert p.windows == 2
+    assert ready_steps == [3, 7]            # window closes AFTER its last
+    assert seen_states.count(prof.ProfilerState.RECORD) == 2
+    assert seen_states.count(prof.ProfilerState.RECORD_AND_RETURN) == 2
+    # each window aggregated its own events only (2 record steps)
+    table = prof.summary()
+    tick = [ln for ln in table.splitlines() if ln.startswith("tick")][0]
+    assert tick.split()[1] == "2"
+
+
+def test_profiler_tuple_scheduler_and_unscheduled():
+    S = prof.ProfilerState
+    fired = []
+    p = prof.Profiler(scheduler=(1, 3),
+                      on_trace_ready=lambda pr: fired.append(pr.step_num),
+                      timer_only=True)
+    p.start()
+    assert p.state == S.CLOSED
+    p.step()                                 # -> step 1: RECORD
+    assert p.state == S.RECORD
+    p.step()                                 # -> step 2: RECORD_AND_RETURN
+    assert p.state == S.RECORD_AND_RETURN
+    p.step()                                 # window closes
+    assert fired == [2] and p.windows == 1   # handler sees the last
+    assert p.state == S.CLOSED               # record step's number
+    p.stop()
+
+    # unscheduled profiler: one window spanning start..stop
+    fired2 = []
+    p2 = prof.Profiler(on_trace_ready=lambda pr: fired2.append(True),
+                       timer_only=True)
+    with p2:
+        with prof.RecordEvent("body"):
+            pass
+    assert fired2 == [True] and p2.windows == 1
+    assert "body" in prof.summary()
+
+
+def test_profiler_stop_mid_window_exports():
+    """A loop that breaks mid-RECORD must not lose the window: stop()
+    exports the partial window (reference Profiler.stop() parity)."""
+    fired = []
+    p = prof.Profiler(scheduler=(0, 5),
+                      on_trace_ready=lambda pr: fired.append(pr.step_num),
+                      timer_only=True)
+    p.start()
+    for _ in range(3):                       # breaks before step 5
+        with prof.RecordEvent("tick"):
+            pass
+        p.step()
+    assert p.state == prof.ProfilerState.RECORD
+    p.stop()
+    assert fired == [3] and p.windows == 1
+    tick = [ln for ln in prof.summary().splitlines()
+            if ln.startswith("tick")][0]
+    assert tick.split()[1] == "3"
+
+
+def test_profiler_export_and_tensorboard_handler(tmp_path):
+    d = str(tmp_path / "tb")
+    handler = prof.export_tensorboard(d, worker_name="w0")
+    p = prof.Profiler(on_trace_ready=handler, timer_only=True)
+    assert p.log_dir == d                    # handler carries the xplane dir
+    with p:
+        with prof.RecordEvent("evt"):
+            pass
+    assert os.path.exists(os.path.join(d, "w0_summary_1.txt"))
+    out = p.export(str(tmp_path / "host.json"))
+    with open(out) as f:
+        assert "traceEvents" in json.load(f)
+    with pytest.raises(ValueError):
+        p.export(str(tmp_path / "x.pb"), format="protobuf")
+
+
+# ---------------------------------------------------------------------------
+# profile_train_step
+# ---------------------------------------------------------------------------
+
+def test_profile_train_step_key_presence():
+    from paddle_tpu.jit.to_static import TrainStep
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+
+    def loss_fn(layer, x, y):
+        return ((layer(x) - y) ** 2).mean()
+
+    step = TrainStep(m, loss_fn,
+                     SGD(learning_rate=0.1, parameters=m.parameters()))
+    rng = np.random.RandomState(0)
+    batch = (rng.rand(4, 4).astype(np.float32),
+             rng.rand(4, 2).astype(np.float32))
+    res = prof.profile_train_step(step, batch, iters=2, warmup=1)
+    assert set(res) == {"compile_s", "host_ms", "dispatch_ms", "step_ms",
+                       "device_ms_est"}
+    assert res["compile_s"] > 0
+    assert res["step_ms"] > 0
+    assert res["device_ms_est"] >= 0

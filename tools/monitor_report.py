@@ -1,0 +1,167 @@
+"""Render a monitor-registry JSONL dump as a human-readable report.
+
+CI/tooling companion of paddle_tpu.monitor (the analogue of the
+reference's profiler summary tables, but fed from the metrics registry):
+given the append-only JSONL written by ``MetricsRegistry.dump_jsonl`` —
+``BENCH_monitor.jsonl`` from bench.py, or an hapi ``MonitorCallback``
+stream — prints:
+
+- the top-k slowest timing histograms (by total seconds);
+- compile/recompile counters (TrainStep jit entries + the process-wide
+  jax backend-compile / persistent-cache / scan-trace gauges);
+- comms traffic: bytes/ops/mean dispatch latency by (op, group);
+- everything else (counters/gauges) as a flat table.
+
+Usage:
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10]
+
+Exit code: 0 on success (including an empty report), 2 on usage/read
+errors. Append-only input is expected: the NEWEST sample per
+(name, labels) wins.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _latest_samples(rows: List[dict]) -> Dict[Tuple[str, tuple], dict]:
+    """Newest line per (name, labels) — file order breaks ts ties, so the
+    last appended dump wins."""
+    out: Dict[Tuple[str, tuple], dict] = {}
+    for row in rows:
+        labels = tuple(sorted((row.get("labels") or {}).items()))
+        out[(row["name"], labels)] = row
+    return out
+
+
+def _fmt_labels(labels: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels) if labels else "-"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def _table(title: str, headers: List[str],
+           rows: List[List[str]]) -> List[str]:
+    if not rows:
+        return []
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(headers)]
+    lines = [f"== {title} ==",
+             "  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append("")
+    return lines
+
+
+def render(rows: List[dict], top: int = 10) -> str:
+    latest = _latest_samples(rows)
+    used = set()
+
+    # -- slowest timing histograms ----------------------------------------
+    timings = []
+    for key, row in latest.items():
+        name, labels = key
+        if row.get("type") == "histogram" and row.get("count"):
+            timings.append((row.get("sum", 0.0), name, labels, row))
+            used.add(key)
+    timings.sort(reverse=True)
+    t_rows = [[name, _fmt_labels(labels), str(int(r["count"])),
+               f"{s:,.3f}", f"{s / r['count'] * 1e3:,.3f}"]
+              for s, name, labels, r in timings[:top]]
+    out = _table(f"Slowest events (top {top} by total time)",
+                 ["event", "labels", "count", "total s", "mean ms"],
+                 t_rows)
+    if len(timings) > top:
+        out.append(f"  ... {len(timings) - top} more timing series "
+                   "(raise --top)\n")
+
+    # -- compile / recompile ----------------------------------------------
+    c_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if ("compile" in name or name.startswith(("jax_", "scan_"))
+                or "trace" in name) and key not in used:
+            row = latest[key]
+            if "value" in row:
+                c_rows.append([name, _fmt_labels(labels),
+                               f"{row['value']:g}"])
+                used.add(key)
+    out += _table("Compile / trace counters", ["metric", "labels", "value"],
+                  c_rows)
+
+    # -- comms by (op, group) ---------------------------------------------
+    comm: Dict[tuple, dict] = {}
+    for key, row in latest.items():
+        name, labels = key
+        if not name.startswith("comm_"):
+            continue
+        used.add(key)
+        d = comm.setdefault(labels, {})
+        if name == "comm_bytes_total":
+            d["bytes"] = row.get("value", 0.0)
+        elif name == "comm_ops_total":
+            d["ops"] = row.get("value", 0.0)
+        elif name == "comm_latency_seconds" and row.get("count"):
+            d["lat_ms"] = row["sum"] / row["count"] * 1e3
+    m_rows = [[_fmt_labels(labels), f"{d.get('ops', 0):g}",
+               _fmt_bytes(d.get("bytes", 0.0)),
+               f"{d.get('lat_ms', 0.0):,.3f}"]
+              for labels, d in sorted(comm.items(),
+                                      key=lambda kv: -kv[1].get("bytes", 0))]
+    out += _table("Collectives (eager dispatch)",
+                  ["op/group", "ops", "bytes", "mean dispatch ms"], m_rows)
+
+    # -- everything else ---------------------------------------------------
+    o_rows = []
+    for key in sorted(latest):
+        if key in used:
+            continue
+        name, labels = key
+        row = latest[key]
+        val = (f"count={int(row['count'])} sum={row.get('sum', 0):g}"
+               if row.get("type") == "histogram"
+               else f"{row.get('value', 0):g}")
+        o_rows.append([name, _fmt_labels(labels), val])
+    out += _table("Other metrics", ["metric", "labels", "value"], o_rows)
+
+    if not out:
+        return "(no metric samples found)"
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    top = 10
+    if "--top" in argv:
+        i = argv.index("--top")
+        try:
+            top = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--top needs an int", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        sys.path.insert(0, __file__.rsplit("/", 2)[0])
+        from paddle_tpu.monitor import load_jsonl
+        rows = load_jsonl(argv[0])
+    except OSError as e:
+        print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 2
+    print(render(rows, top=top), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
