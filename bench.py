@@ -29,25 +29,61 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-# Peak dense matmul FLOP/s per chip (bf16).  f32 params are fine: the
-# default matmul policy lowers f32 gemms to bf16 passes on TPU.
-PEAKS = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5": 459e12,        # v5p
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,   # v6e
-}
 CUDA_PARITY_MFU = 0.40
 
 
 def device_peak_flops() -> float:
-    import jax
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAKS.items():
-        if kind.startswith(k):
-            return v
-    log(f"unknown device kind {kind!r}; assuming 100 TFLOP/s")
-    return 100e12
+    """Peak dense FLOP/s — the per-chip table lives in
+    paddle_tpu.cost_model (one source of truth with TrainStep's MFU
+    gauge)."""
+    from paddle_tpu.cost_model import device_peak_flops as peak
+    v = peak()
+    if v is None:
+        import jax
+        log(f"unknown device kind {jax.devices()[0].device_kind!r}; "
+            "assuming 100 TFLOP/s")
+        return 100e12
+    return v
+
+
+def step_program(step) -> dict:
+    """The 'step' program's cost/memory attribution from
+    TrainStep.stats() — flops/bytes from lowered.cost_analysis(), the
+    peak-HBM estimate from compiled.memory_analysis(). Empty dict when
+    the backend publishes no cost model (MFU then falls back to the
+    per-model analytic FLOP formulas)."""
+    try:
+        return dict(step.stats().get("programs", {}).get("step") or {})
+    except Exception as e:
+        log(f"cost attribution unavailable: {e!r}")
+        return {}
+
+
+def attributed_mfu(step, dt_s: float, fallback_flops_step: float) -> float:
+    """MFU from the compiler's own FLOP count for the executed step
+    (replaces the hand-maintained per-model constants; the analytic
+    formula remains only as the no-cost-model fallback)."""
+    prog = step_program(step)
+    flops = float(prog.get("flops") or 0.0)
+    src = "cost_analysis"
+    if not flops:
+        flops, src = float(fallback_flops_step), "analytic-fallback"
+    mfu = flops / dt_s / device_peak_flops()
+    log(f"mfu source: {src} ({flops:.3e} FLOPs/step)")
+    return mfu
+
+
+def peak_hbm_line(name: str, step) -> dict | None:
+    """Gated ``<model>_peak_hbm_bytes`` metric line (compare_common-safe:
+    absent from old records it simply isn't gated; bytes count as
+    lower-is-better in check_bench)."""
+    peak = step_program(step).get("peak_hbm_bytes") or 0
+    if not peak:
+        return None
+    log(f"{name}: static peak-HBM estimate {peak / 2**30:.2f} GiB "
+        "(train step executable)")
+    return metric_line(f"{name}_peak_hbm_bytes", peak, "bytes",
+                       vs_baseline=1.0)
 
 
 def steady_ms(call, iters: int, repeats: int = 3) -> float:
@@ -168,16 +204,19 @@ def bench_bert_mlm() -> dict:
     except Exception as e:
         log(f"bert breakdown failed: {e!r}")
 
-    # Training FLOPs/token ~= 6*P_matmul + 12*L*h*S (PaLM appendix B).
+    # Fallback FLOPs/token ~= 6*P_matmul + 12*L*h*S (PaLM appendix B) —
+    # used only when the backend publishes no cost model; the primary
+    # count comes from the compiled step itself via step_program().
     h, L = cfg.hidden_size, cfg.num_layers
     p_block = L * (12 * h * h)                       # qkvo + 2 mlp mats
     p_embed_head = cfg.vocab_size * h                # tied decoder gemm
     flops_token = 6 * (p_block + p_embed_head * M / S) + 12 * L * h * S
-    mfu = tokens_per_sec * flops_token / device_peak_flops()
+    mfu = attributed_mfu(step, dt, flops_token * B * S)
     log(f"bert: {dt*1e3:.1f} ms/step  {tokens_per_sec:,.0f} tok/s  "
         f"MFU={mfu:.3f}")
     return {"tokens_per_sec": tokens_per_sec, "mfu": mfu,
-            "ms_per_step": dt * 1e3, "compile_s": compile_s}
+            "ms_per_step": dt * 1e3, "compile_s": compile_s,
+            "hbm_line": peak_hbm_line("bert_base_mlm", step)}
 
 
 def bench_eager_dispatch() -> None:
@@ -296,16 +335,17 @@ def bench_resnet50():
         float(step(x, y))
         dt = steady_ms(lambda: step(x, y), iters=40, repeats=3) / 1e3
         imgs = B / dt
-        # ResNet-50 fwd ≈ 4.1 GFLOP/img at 224² (fwd+bwd ≈ 3×fwd); CUDA
-        # parity proxy for convnets is ~0.30 MFU (well-tuned fp16 A100
-        # ResNet sits near 25-35% of dense peak)
-        mfu = imgs * 3 * 4.1e9 / device_peak_flops()
+        # fallback: ResNet-50 fwd ≈ 4.1 GFLOP/img at 224² (fwd+bwd ≈
+        # 3×fwd); CUDA parity proxy for convnets is ~0.30 MFU
+        # (well-tuned fp16 A100 ResNet sits near 25-35% of dense peak)
+        mfu = attributed_mfu(step, dt, B * 3 * 4.1e9)
         log(f"resnet50: {dt*1e3:.1f} ms/step  {imgs:,.0f} img/s "
             f"MFU={mfu:.3f} (B={B}, min of 3 runs)")
         return [metric_line("resnet50_train_imgs_per_sec", imgs, "img/s",
                             vs_baseline=mfu / 0.30, mfu=mfu),
                 metric_line("resnet50_compile_step1_s", compile_s, "s",
-                            vs_baseline=1.0)]
+                            vs_baseline=1.0),
+                peak_hbm_line("resnet50", step)]
     except Exception as e:
         log(f"resnet50 bench failed: {e!r}")
         return None
@@ -378,11 +418,11 @@ def bench_gpt2_pp_tp() -> None:
         log(f"gpt2-345M PP+TP bench failed: {e!r}")
 
 
-def gpt_model_mfu(tok_s, h=1024, L=24, V=50304, S=1024) -> float:
-    """Model-FLOPs utilization (6P + attention term, PaLM appendix B)."""
+def gpt_flops_per_token(h=1024, L=24, V=50304, S=1024) -> float:
+    """Analytic training FLOPs/token (6P + attention term, PaLM appendix
+    B) — the no-cost-model fallback for attributed_mfu."""
     p_block = L * 12 * h * h
-    flops_token = 6 * (p_block + V * h) + 12 * L * h * S
-    return tok_s * flops_token / device_peak_flops()
+    return 6 * (p_block + V * h) + 12 * L * h * S
 
 
 def bench_gpt2_345m():
@@ -429,12 +469,14 @@ def bench_gpt2_345m():
         dt = steady_ms(lambda: step(ids, labels), iters=40,
                        repeats=3) / 1e3
         tok = B * S / dt
-        mfu = gpt_model_mfu(tok, S=S)
+        mfu = attributed_mfu(step, dt,
+                             gpt_flops_per_token(S=S) * B * S)
         log(f"gpt2-345M: {dt*1e3:.1f} ms/step  {tok:,.0f} tok/s  "
-            f"model-MFU={mfu:.3f} (B={B}, S={S}, AMP O1, min of 3 runs)")
+            f"MFU={mfu:.3f} (B={B}, S={S}, AMP O1, min of 3 runs)")
         return [metric_line("gpt2_345m_tokens_per_sec_per_chip", tok,
                             "tokens/s", vs_baseline=mfu / CUDA_PARITY_MFU,
                             mfu=mfu),
+                peak_hbm_line("gpt2_345m", step),
                 # NOTE: compile+step1 collapses on a warm persistent
                 # cache — cross-record gating of *_compile_step1_s is only
                 # apples-to-apples between equally-cold runs (the driver
@@ -494,14 +536,15 @@ def bench_ernie():
         p_block = L * 12 * h * h
         flops_token = (6 * (p_block + cfg.vocab_size * h * M / S)
                        + 12 * L * h * S)
-        mfu = tok * flops_token / device_peak_flops()
+        mfu = attributed_mfu(step, dt, flops_token * B * S)
         log(f"ernie-base: {dt*1e3:.1f} ms/step  {tok:,.0f} tok/s  "
             f"MFU={mfu:.3f} (B={B}, S={S}, AMP O1, min of 3 runs)")
         return [metric_line("ernie_base_pretrain_tokens_per_sec_per_chip",
                             tok, "tokens/s",
                             vs_baseline=mfu / CUDA_PARITY_MFU, mfu=mfu),
                 metric_line("ernie_base_compile_step1_s", compile_s, "s",
-                            vs_baseline=1.0, mfu=mfu)]
+                            vs_baseline=1.0, mfu=mfu),
+                peak_hbm_line("ernie_base", step)]
     except Exception as e:
         log(f"ernie bench failed: {e!r}")
         return None
@@ -543,8 +586,11 @@ def main() -> None:
         bench_gpt2_pp_tp()
         add(bench_ernie())
     r = bench_bert_mlm()
-    # compile line BEFORE the throughput line: the headline (BERT tokens/s)
-    # metric must stay the LAST printed JSON line for last-line parsers
+    # compile + HBM lines BEFORE the throughput line: the headline (BERT
+    # tokens/s) metric must stay the LAST printed JSON line for
+    # last-line parsers
+    if r.get("hbm_line"):
+        metrics.append(r["hbm_line"])
     metrics.append(metric_line(
         "bert_base_mlm_compile_step1_s", r["compile_s"], "s",
         vs_baseline=1.0, mfu=r["mfu"]))
@@ -566,8 +612,11 @@ def main() -> None:
     try:
         import os as _os
         from paddle_tpu.monitor import get_registry
+        from paddle_tpu.monitor.memory import publish_census
         from paddle_tpu.utils.compilation import publish_compile_counts
         publish_compile_counts()
+        publish_census()      # live-buffer bytes by category, for the
+        # tools/monitor_report.py --memory section
         mpath = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                               "BENCH_monitor.jsonl")
         get_registry().dump_jsonl(mpath, extra={"source": "bench"})

@@ -145,6 +145,32 @@ define_flag("monitor", False,
             "always on (registry writes are noise next to a shard_map "
             "dispatch) and the check_numerics watchdog is its own "
             "TrainStep argument — neither is gated by this flag.")
+define_flag("memory_preflight", "",
+            "OOM pre-flight check: when a TrainStep program compiles, "
+            "compare its static HBM estimate (monitor.memory, from "
+            "compiled.memory_analysis()) against the device HBM budget "
+            "BEFORE step 1 touches real capacity. '' (default) = off; "
+            "'warn' = RuntimeWarning when the estimate exceeds the "
+            "budget; 'raise' = MemoryBudgetError. No-op when the budget "
+            "is unknown (CPU test backend) and no explicit limit is set.")
+define_flag("memory_preflight_limit_mb", 0,
+            "Explicit HBM budget (MiB) for the pre-flight check; 0 = ask "
+            "the device (memory_stats()['bytes_limit']). Set it to a "
+            "TARGET chip's HBM to answer 'will this config fit a v5e?' "
+            "from any dev machine.")
+define_flag("flight_recorder", False,
+            "Record every TrainStep into the crash flight recorder ring "
+            "buffer even with FLAGS_monitor off, and install the "
+            "unhandled-exception + faulthandler dump hooks at the first "
+            "TrainStep construction. (FLAGS_monitor on also records "
+            "steps; this flag adds the hooks and keeps recording when "
+            "the registry stream is off.)")
+define_flag("flight_recorder_dir", "",
+            "Directory for flight-recorder dump files "
+            "(flight_recorder_*.json); empty = current directory.")
+define_flag("flight_recorder_capacity", 256,
+            "Ring-buffer size of the flight recorder: how many recent "
+            "step records survive to a crash dump.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

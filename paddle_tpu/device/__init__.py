@@ -37,7 +37,38 @@ def get_available_custom_device():
 
 
 class cuda:
-    """paddle.device.cuda shims: 'cuda' means the attached accelerator."""
+    """paddle.device.cuda shims: 'cuda' means the attached accelerator.
+
+    Memory accounting routes through :mod:`paddle_tpu.monitor.memory`
+    (``device_memory_stats``) — the same plumbing the per-program HBM
+    budgets and ``memory_summary()`` use. All functions degrade to 0 on
+    backends that publish no allocator stats (``memory_stats()`` is None
+    on CPU), matching the reference's CPU behavior.
+    """
+
+    # reset_max_memory_allocated watermarks per device id: XLA's peak
+    # counter is monotonic with no reset API, so the shim remembers the
+    # peak at reset time and reports a fresh high-water mark only when
+    # the raw peak has since moved past it (best-effort; in-window peaks
+    # below the old one are unobservable from the runtime's counters).
+    _peak_baseline: dict = {}
+
+    @staticmethod
+    def _stats(device=None):
+        from ..monitor.memory import device_memory_stats
+        return device_memory_stats(cuda._resolve(device))
+
+    @staticmethod
+    def _resolve(device=None):
+        import jax
+        try:
+            if device is None:
+                return jax.devices()[0]
+            if isinstance(device, int):
+                return jax.devices()[device]
+            return device
+        except Exception:
+            return None
 
     @staticmethod
     def device_count() -> int:
@@ -53,19 +84,53 @@ class cuda:
         pass          # XLA owns HBM; nothing to release eagerly
 
     @staticmethod
-    def max_memory_allocated(device=None) -> int:
-        import jax
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-            return int(stats.get("peak_bytes_in_use", 0))
-        except Exception:
-            return 0
+    def memory_allocated(device=None) -> int:
+        stats = cuda._stats(device)
+        return int((stats or {}).get("bytes_in_use", 0))
 
     @staticmethod
-    def memory_allocated(device=None) -> int:
-        import jax
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-            return int(stats.get("bytes_in_use", 0))
-        except Exception:
+    def max_memory_allocated(device=None) -> int:
+        stats = cuda._stats(device)
+        if not stats:
             return 0
+        peak = int(stats.get("peak_bytes_in_use", 0))
+        dev = cuda._resolve(device)
+        base = cuda._peak_baseline.get(getattr(dev, "id", 0))
+        if base is None:
+            return peak
+        if peak > base:
+            return peak
+        return int(stats.get("bytes_in_use", 0))
+
+    @staticmethod
+    def reset_max_memory_allocated(device=None) -> None:
+        """Start a fresh peak-memory window (reference:
+        ``paddle.device.cuda.reset_max_memory_allocated``)."""
+        stats = cuda._stats(device)
+        dev = cuda._resolve(device)
+        cuda._peak_baseline[getattr(dev, "id", 0)] = \
+            int((stats or {}).get("peak_bytes_in_use", 0))
+
+    @staticmethod
+    def memory_reserved(device=None) -> int:
+        """Bytes the runtime holds from the system for this device (>=
+        allocated); falls back to bytes_in_use where the backend keeps
+        no separate pool counter."""
+        stats = cuda._stats(device)
+        if not stats:
+            return 0
+        for k in ("bytes_reserved", "pool_bytes", "bytes_in_use"):
+            if k in stats:
+                return int(stats[k])
+        return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None) -> int:
+        stats = cuda._stats(device)
+        if not stats:
+            return 0
+        for k in ("peak_bytes_reserved", "peak_pool_bytes",
+                  "peak_bytes_in_use"):
+            if k in stats:
+                return int(stats[k])
+        return 0

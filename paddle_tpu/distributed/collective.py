@@ -265,6 +265,14 @@ def _comm_trace(op: str, group: Group, x, cache_key=None):
                       if warm else
                       "first-call eager collective dispatch incl. "
                       "trace+compile").observe(dt, **labels)
+        # crash forensics: collectives land in the flight-recorder event
+        # ring too (a run that dies mid-sync should say so in the dump);
+        # gated like the TrainStep records — off = zero recorder writes
+        from ..monitor import flight_recorder as _flight
+        if _flight.enabled():
+            _flight.get_flight_recorder().record_event(
+                "collective", op=op, group=group.axis_name,
+                nranks=group.nranks, bytes=nbytes, dispatch_ms=dt * 1e3)
     except Exception:
         pass
 

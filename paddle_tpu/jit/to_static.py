@@ -379,6 +379,19 @@ class TrainStep:
         self._kinds_compiled: set = set()
         self._stats = {"compiles": 0, "recompiles": 0,
                        "grad_accum_syncs": 0, "nonfinite_trips": 0}
+        # per-program-kind attribution (ISSUE 4): cost from
+        # lowered.cost_analysis(), HBM budget from
+        # compiled.memory_analysis() — captured once per compile (never
+        # on the step hot path), readable via stats() with monitor off
+        self._programs: Dict[str, dict] = {}
+        self._program_memory: Dict[str, Any] = {}
+        self._wall_ema: Dict[str, float] = {}
+        from ..core.flags import get_flag
+        if get_flag("flight_recorder"):
+            # crash forensics opt-in: excepthook + faulthandler dump
+            # hooks from the first TrainStep on (docs/OBSERVABILITY.md)
+            from ..monitor.flight_recorder import get_flight_recorder
+            get_flight_recorder().install()
         from ..core.tensor import eager_cache_stats
         from ..utils.compilation import compile_counts
         self._cc0 = compile_counts()
@@ -525,7 +538,7 @@ class TrainStep:
         return step
 
     # -- telemetry (paddle_tpu.monitor) ------------------------------------
-    def _note_compile(self, kind: str, mon: bool):
+    def _note_compile(self, kind: str, mon: bool, fr: bool = False):
         """A jit-cache miss: a new executable is about to be built. A miss
         for a program KIND that already has a compiled entry is a
         RECOMPILE (shape change, flag flip) — the event the scan-layer
@@ -537,6 +550,11 @@ class TrainStep:
         if recompile:
             st["recompiles"] += 1
         self._kinds_compiled.add(kind)
+        if fr:
+            from ..monitor.flight_recorder import get_flight_recorder
+            get_flight_recorder().record_event(
+                "recompile" if recompile else "compile", kind=kind,
+                step=self.step_count)
         if mon:
             from ..monitor import get_registry
             reg = get_registry()
@@ -548,9 +566,110 @@ class TrainStep:
                             "TrainStep recompiles (new signature for an "
                             "already-compiled program kind)").inc(kind=kind)
 
+    def _compile_program(self, kind: str, fn: Callable, donate_argnums,
+                         example_args, mon: bool):
+        """Build one program's executable AOT (``lower`` + ``compile``)
+        so its cost/memory attribution comes from the SAME lowering and
+        executable the step will run — one trace, one backend compile,
+        exactly like the dispatch path, but with the ``Lowered`` and
+        ``Compiled`` stages in hand for ``cost_analysis()`` /
+        ``memory_analysis()`` (the dispatch path hides both)."""
+        jitted = jax.jit(fn, donate_argnums=donate_argnums)
+
+        def build(args):
+            with _control_flow_guidance():
+                lowered = jitted.lower(*args)
+            try:
+                compiled = lowered.compile()
+            except Exception:
+                # AOT stage unavailable (exotic backend/version): the
+                # dispatch path still runs the step; attribution skipped.
+                return None
+            self._attribute_program(kind, lowered, compiled, mon)
+            return compiled
+
+        compiled = build(example_args)
+        if compiled is None:
+            return jitted
+        state = {"compiled": compiled, "heals": 0}
+
+        def call(*args):
+            if state["compiled"] is None:
+                return jitted(*args)
+            try:
+                return state["compiled"](*args)
+            except ValueError as e:
+                if "Compiled object called with" not in str(e):
+                    raise
+                # Input shardings/layouts moved since this signature was
+                # compiled — e.g. ZeRO: XLA shards the updated params
+                # over the zero axis on output, so step 2's inputs no
+                # longer match step 1's executable. The dispatch path
+                # silently recompiles here; do the same, re-attributing
+                # from the new executable (newest wins). The mismatch is
+                # detected BEFORE execution, so donated args are intact.
+                state["heals"] += 1
+                if state["heals"] > 2:
+                    # layouts keep flip-flopping under one shape
+                    # signature: hand the entry to dispatch-mode jit,
+                    # whose executable cache holds every layout at once
+                    state["compiled"] = None
+                    return jitted(*args)
+                fresh = build(args)
+                if fresh is None:
+                    state["compiled"] = None
+                    return jitted(*args)
+                state["compiled"] = fresh
+                return fresh(*args)
+
+        return call
+
+    def _attribute_program(self, kind: str, lowered, compiled, mon: bool):
+        """Capture per-program FLOPs/bytes and the static HBM budget,
+        register the budget process-wide, run the flag-gated OOM
+        pre-flight, and (monitor on) publish attribution gauges."""
+        from ..cost_model import CostModel
+        from ..monitor import memory as monitor_memory
+        entry = CostModel().attribute(lowered)
+        pm = monitor_memory.analyze_compiled(compiled, kind=kind)
+        if pm is not None:
+            entry.update(peak_hbm_bytes=pm.peak_bytes,
+                         argument_bytes=pm.argument_bytes,
+                         output_bytes=pm.output_bytes,
+                         temp_bytes=pm.temp_bytes,
+                         generated_code_bytes=pm.generated_code_bytes)
+            self._program_memory[kind] = pm
+            monitor_memory.record_program(pm)
+        self._programs[kind] = entry
+        if mon:
+            from ..monitor import get_registry
+            reg = get_registry()
+            reg.gauge("train_step_program_flops",
+                      "static FLOPs per execution by program kind "
+                      "(lowered.cost_analysis)").set(entry["flops"],
+                                                     kind=kind)
+            reg.gauge("train_step_program_bytes_accessed",
+                      "static bytes accessed per execution by program "
+                      "kind").set(entry["bytes_accessed"], kind=kind)
+            if pm is not None:
+                reg.gauge("train_step_program_peak_hbm_bytes",
+                          "static peak-HBM estimate by program kind "
+                          "(compiled.memory_analysis)"
+                          ).set(pm.peak_bytes, kind=kind)
+        if pm is not None:
+            # OOM pre-flight BEFORE step 1 touches real capacity;
+            # no-op unless FLAGS_memory_preflight is set
+            monitor_memory.preflight_check(pm)
+
     def _record_step_metrics(self, t_wall: float, dispatch_s: float,
                              kind: str = "step"):
         from ..monitor import get_registry
+        wall = time.perf_counter() - t_wall
+        # per-kind wall EMA feeds the stats() MFU gauge (monitor-mode
+        # only; meaningful when the loop blocks per step, as bench does)
+        prev = self._wall_ema.get(kind)
+        self._wall_ema[kind] = wall if prev is None \
+            else 0.8 * prev + 0.2 * wall
         reg = get_registry()
         reg.counter("train_step_steps_total",
                     "TrainStep calls by program kind").inc(kind=kind)
@@ -559,8 +678,7 @@ class TrainStep:
                       "dispatch)").observe(dispatch_s, kind=kind)
         reg.histogram("train_step_wall_seconds",
                       "full TrainStep.__call__ wall time (host prep + "
-                      "dispatch)").observe(time.perf_counter() - t_wall,
-                                           kind=kind)
+                      "dispatch)").observe(wall, kind=kind)
 
     @contextlib.contextmanager
     def _step_span(self, mon: bool, name: str = "TrainStep.step"):
@@ -619,6 +737,16 @@ class TrainStep:
                + " (TrainStep check_numerics watchdog; the in-graph "
                "variant is FLAGS_check_nan_inf)")
         offender = bad_grad or bad_param or "loss"
+        # crash forensics: a watchdog trip dumps the flight recorder
+        # (ring of recent steps + fingerprint), naming the trip step —
+        # best-effort, the NonFiniteError below must win
+        from ..monitor import flight_recorder as _flight
+        dump_path = _flight.trip_dump(step=step_index,
+                                      reason="nan_watchdog",
+                                      offender=offender,
+                                      step_kind=step_kind)
+        if dump_path:
+            msg += f"; flight recorder dump: {dump_path}"
         if self._check_numerics == "warn":
             import warnings
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
@@ -630,13 +758,32 @@ class TrainStep:
         (``compiles``/``recompiles`` — a warm scan-layer GPT shows exactly
         1 and 0), XLA backend-compile / persistent-cache / trace deltas
         (process-wide window, via utils.compilation), eager op-cache hit
-        rates, and accumulation/watchdog counters. Plain-dict reads — no
-        device sync, callable every step."""
+        rates, accumulation/watchdog counters, and per-program-kind
+        attribution under ``programs``: static flops / bytes_accessed /
+        arithmetic_intensity (lowered.cost_analysis) and the
+        ``peak_hbm_bytes`` budget (compiled.memory_analysis), plus an
+        ``mfu`` gauge when the chip's peak FLOP/s is known and monitor
+        mode has a wall-time EMA for the kind (None otherwise — e.g. the
+        CPU test backend). Plain-dict reads — no device sync, callable
+        every step."""
         from ..core.tensor import eager_cache_stats
         from ..utils.compilation import compile_counts
         cc = compile_counts()
         ec = eager_cache_stats()
         d = dict(self._stats)
+        try:
+            from ..cost_model import device_peak_flops
+            peak = device_peak_flops()
+        except Exception:
+            peak = None
+        programs = {}
+        for kind, entry in self._programs.items():
+            e = dict(entry)
+            wall = self._wall_ema.get(kind)
+            e["mfu"] = (e["flops"] / (wall * peak)
+                        if peak and wall and e.get("flops") else None)
+            programs[kind] = e
+        d["programs"] = programs
         d.update(
             steps=self.step_count,
             microsteps=self._micro_count,
@@ -654,7 +801,7 @@ class TrainStep:
                                      if seen else None)
         return d
 
-    def _call_accum(self, flat, treedef, check, mon, t_wall):
+    def _call_accum(self, flat, treedef, check, mon, fr, t_wall):
         """Gradient-merge path: k-1 accumulate-only microsteps, then one
         accumulate+update microstep."""
         if self._acc_grads is None:
@@ -669,23 +816,31 @@ class TrainStep:
             sig = ("acc", _sig_of(flat)[0], treedef)
             jitted = self._jitted.get(sig)
             if jitted is None:
-                self._note_compile("accum", mon)
+                self._note_compile("accum", mon, fr)
                 fn = self._make_accum_step(treedef)
                 # _donation_safe re-checked per compiled entry: the
                 # persistent cache may be enabled after construction
-                jitted = jax.jit(fn, donate_argnums=(2,)
-                                 if self._donate and _donation_safe()
-                                 else ())
+                jitted = self._compile_program(
+                    "accum", fn,
+                    (2,) if self._donate and _donation_safe() else (),
+                    (self.params, self.buffers, self._acc_grads, key,
+                     flat), mon)
                 self._jitted[sig] = jitted
             t0 = time.perf_counter() if mon else 0.0
             with _control_flow_guidance(), self._step_span(
                     mon, "TrainStep.accum_microstep"):
                 self.buffers, self._acc_grads, loss = jitted(
                     self.params, self.buffers, self._acc_grads, key, flat)
+            dispatch_s = time.perf_counter() - t0 if mon else None
             if mon:
-                self._record_step_metrics(t_wall,
-                                          time.perf_counter() - t0,
+                self._record_step_metrics(t_wall, dispatch_s,
                                           kind="accum")
+            if fr:
+                from ..monitor.flight_recorder import get_flight_recorder
+                get_flight_recorder().record_step(
+                    self._micro_count, loss=loss, kind="accum",
+                    dispatch_ms=None if dispatch_s is None
+                    else dispatch_s * 1e3)
             if self._check_numerics:
                 self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
                                self._micro_count, step_kind="microstep")
@@ -696,10 +851,13 @@ class TrainStep:
         sig = ("apply", _sig_of(flat)[0], treedef, check)
         jitted = self._jitted.get(sig)
         if jitted is None:
-            self._note_compile("apply", mon)
+            self._note_compile("apply", mon, fr)
             fn = self._make_apply_step(treedef, check_finite=check)
-            jitted = jax.jit(fn, donate_argnums=(0, 2, 3)
-                             if self._donate and _donation_safe() else ())
+            jitted = self._compile_program(
+                "apply", fn,
+                (0, 2, 3) if self._donate and _donation_safe() else (),
+                (self.params, self.buffers, self.opt_state,
+                 self._acc_grads, lr, t, key, flat), mon)
             self._jitted[sig] = jitted
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(
@@ -710,9 +868,9 @@ class TrainStep:
         # folded into the optimizer here (reference: the gated update
         # block of gradient_merge_optimizer.py)
         self._stats["grad_accum_syncs"] += 1
+        dispatch_s = time.perf_counter() - t0 if mon else None
         if mon:
-            self._record_step_metrics(t_wall, time.perf_counter() - t0,
-                                      kind="apply")
+            self._record_step_metrics(t_wall, dispatch_s, kind="apply")
             from ..monitor import get_registry
             get_registry().counter(
                 "train_step_grad_accum_syncs_total",
@@ -728,6 +886,14 @@ class TrainStep:
         else:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss) = out
+        if fr:
+            from ..monitor.flight_recorder import get_flight_recorder
+            get_flight_recorder().record_step(
+                self.step_count, loss=loss, kind="apply",
+                wall_ms=(time.perf_counter() - t_wall) * 1e3 if mon
+                else None,
+                dispatch_ms=None if dispatch_s is None
+                else dispatch_s * 1e3)
         if self._check_numerics:
             self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
                            self.step_count)
@@ -741,28 +907,33 @@ class TrainStep:
         raw = self._place_batch(raw)
         flat, treedef = jax.tree_util.tree_flatten(raw)
         check = bool(get_flag("check_nan_inf"))
+        fr = mon or bool(get_flag("flight_recorder"))
         if self.grad_accum_steps > 1:
-            return self._call_accum(flat, treedef, check, mon, t_wall)
-        sig = (_sig_of(flat)[0], treedef, check)
-        jitted = self._jitted.get(sig)
-        if jitted is None:
-            self._note_compile("step", mon)
-            fn = self._make_step(treedef, check_finite=check)
-            donate = (0, 2) if self._donate and _donation_safe() else ()
-            jitted = jax.jit(fn, donate_argnums=donate)
-            self._jitted[sig] = jitted
+            return self._call_accum(flat, treedef, check, mon, fr, t_wall)
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = make_rng("train_step")
+        sig = (_sig_of(flat)[0], treedef, check)
+        jitted = self._jitted.get(sig)
+        if jitted is None:
+            self._note_compile("step", mon, fr)
+            fn = self._make_step(treedef, check_finite=check)
+            donate = (0, 2) if self._donate and _donation_safe() else ()
+            jitted = self._compile_program(
+                "step", fn, donate,
+                (self.params, self.buffers, self.opt_state, lr, t, key,
+                 flat), mon)
+            self._jitted[sig] = jitted
         prev = ((self.params, self.buffers) if self._check_numerics
                 else None)
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(mon):
             out = jitted(self.params, self.buffers, self.opt_state, lr, t,
                          key, flat)
+        dispatch_s = time.perf_counter() - t0 if mon else None
         if mon:
-            self._record_step_metrics(t_wall, time.perf_counter() - t0)
+            self._record_step_metrics(t_wall, dispatch_s)
         if check:
             self.params, self.buffers, self.opt_state, loss, flags = out
             bad = [k for k, ok in flags.items() if not bool(ok)]
@@ -772,6 +943,14 @@ class TrainStep:
                     f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
         else:
             self.params, self.buffers, self.opt_state, loss = out
+        if fr:
+            from ..monitor.flight_recorder import get_flight_recorder
+            get_flight_recorder().record_step(
+                self.step_count, loss=loss, kind="step",
+                wall_ms=(time.perf_counter() - t_wall) * 1e3 if mon
+                else None,
+                dispatch_ms=None if dispatch_s is None
+                else dispatch_s * 1e3)
         if self._check_numerics:
             self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
                            self.step_count)

@@ -1,6 +1,6 @@
 """paddle_tpu.monitor — unified training telemetry.
 
-Four pillars (ISSUE 3 tentpole; see docs/OBSERVABILITY.md):
+Time-domain pillars (ISSUE 3; see docs/OBSERVABILITY.md):
 
 1. a structured **metrics registry** (:mod:`.metrics`): thread-safe
    Counter/Gauge/Histogram with labels, Prometheus text + append-only
@@ -17,11 +17,36 @@ Four pillars (ISSUE 3 tentpole; see docs/OBSERVABILITY.md):
    that name the first offending parameter/gradient and step index,
    AMP-GradScaler aware.
 
+Memory/cost/forensics pillars (ISSUE 4, the space-domain counterpart):
+
+5. **HBM memory accounting** (:mod:`.memory`): static per-program
+   budgets from ``compiled.memory_analysis()`` (surfaced per program
+   kind in ``TrainStep.stats()['programs']``), the flag-gated OOM
+   pre-flight check (``FLAGS_memory_preflight``), a live-buffer census
+   over ``jax.live_arrays()`` with :class:`~.memory.LeakMonitor`
+   growth detection, and :func:`~.memory.memory_summary`;
+6. **per-program cost attribution** — FLOPs/bytes/arithmetic intensity
+   from ``lowered.cost_analysis()`` via :mod:`paddle_tpu.cost_model`
+   (one shared source of truth with ``CostModel.profile_measure`` and
+   bench.py's MFU math);
+7. the **crash flight recorder** (:mod:`.flight_recorder`): a bounded
+   ring of recent step records + events + an environment fingerprint,
+   dumped to JSON on unhandled exceptions, NaN-watchdog trips, or
+   explicit ``dump()``, with faulthandler wiring for hard crashes.
+
 The registry is always importable and writable; the HOT paths only write
 to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
-the write_count guard in tests/test_monitor.py).
+the write_count guard in tests/test_monitor.py; the flight recorder has
+the same contract via ``FLAGS_flight_recorder`` and its
+``record_count`` probe).
 """
 
+from . import flight_recorder, memory  # noqa: F401
+from .flight_recorder import (FlightRecorder,  # noqa: F401
+                              get_flight_recorder, set_flight_recorder)
+from .memory import (LeakMonitor, MemoryBudgetError,  # noqa: F401
+                     ProgramMemory, live_buffer_census, memory_summary,
+                     preflight_check)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       get_registry, load_jsonl, scoped_registry)
 from .numerics import (NaNWatchdog, NonFiniteError, all_finite,  # noqa: F401
@@ -32,6 +57,9 @@ __all__ = [
     "scoped_registry", "load_jsonl",
     "NaNWatchdog", "NonFiniteError", "all_finite", "check_numerics",
     "first_nonfinite", "nonfinite_entries",
+    "ProgramMemory", "MemoryBudgetError", "LeakMonitor",
+    "live_buffer_census", "memory_summary", "preflight_check",
+    "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
     "enabled",
 ]
 

@@ -49,6 +49,21 @@ def _seed():
 
 
 @pytest.fixture(autouse=True)
+def _flight_recorder_isolation(tmp_path):
+    """Watchdog-trip flight-recorder dumps must land in the test's tmp
+    dir (not the repo cwd), and recorder ring state must not leak
+    between tests."""
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.monitor import flight_recorder as fr
+    old = fr.set_flight_recorder(None)
+    with flag_scope("flight_recorder_dir", str(tmp_path)):
+        yield
+    current = fr.set_flight_recorder(old)
+    if current is not None:
+        current.uninstall()
+
+
+@pytest.fixture(autouse=True)
 def _fleet_isolation():
     """fleet state must not leak between tests: whatever a test does to
     the fleet globals (init, strategy attach) is rolled back to the

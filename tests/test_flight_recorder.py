@@ -1,0 +1,253 @@
+"""Crash flight recorder (ISSUE 4): ring-buffer bounds, dump-on-exception
+and dump-on-watchdog-trip produce valid JSON, fingerprint fields, and the
+monitor-off zero-overhead contract."""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.monitor import NonFiniteError, flight_recorder as FR
+from paddle_tpu.optimizer import SGD
+
+
+def _mse(layer, x, y):
+    return ((layer(x) - y) ** 2).mean()
+
+
+def _linear_step(**kw):
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.1, parameters=m.parameters())
+    return TrainStep(m, _mse, opt, **kw)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(8, 4).astype(np.float32),
+            rng.rand(8, 2).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# ring buffer + dump mechanics
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_bounds():
+    fr = FR.FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record_step(i, loss=float(i), kind="step")
+    steps = fr.steps
+    assert len(steps) == 4                      # bounded
+    assert [r["step"] for r in steps] == [6, 7, 8, 9]   # newest survive
+    for i in range(500):
+        fr.record_event("compile", kind="step")
+    assert len(fr.events) <= 128
+    assert fr.record_count == 510
+
+
+def test_dump_roundtrip_and_fingerprint(tmp_path):
+    fr = FR.FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    fr.record_step(1, loss=0.5, wall_ms=1.2, dispatch_ms=0.3)
+    fr.record_step(2, loss=float("nan"))        # non-finite must survive
+    fr.record_event("recompile", kind="step", step=2)
+    path = fr.dump(reason="explicit")
+    assert os.path.dirname(path) == str(tmp_path)
+    with open(path) as f:
+        doc = json.load(f)                      # strictly valid JSON
+    assert doc["reason"] == "explicit"
+    assert doc["capacity"] == 8
+    fp = doc["fingerprint"]
+    import jax
+    assert fp["jax_version"] == jax.__version__
+    assert fp["backend"] == "cpu"
+    assert fp["device_count"] == len(jax.devices())
+    assert fp["pid"] == os.getpid()
+    assert fp["python"] == sys.version.split()[0]
+    assert fp["paddle_tpu_version"]
+    assert "git_sha" in fp
+    # flags snapshot travels with the dump
+    assert doc["flags"]["monitor"] is False
+    assert [r["step"] for r in doc["steps"]] == [1, 2]
+    assert doc["steps"][0]["wall_ms"] == pytest.approx(1.2)
+    assert doc["steps"][1]["loss"] == "nan"     # stringified non-finite
+    assert doc["steps"][0]["seed"] == 1234      # conftest paddle.seed
+    assert doc["events"][0]["event"] == "recompile"
+    assert FR.load_dump(path) == doc
+    # second dump overwrites (newest state of this process wins)
+    fr.record_step(3, loss=0.1)
+    assert fr.dump() == fr.default_path()
+    assert len(FR.load_dump(fr.default_path())["steps"]) == 3
+
+
+def test_dump_on_unhandled_exception(tmp_path):
+    fr = FR.FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+    FR.set_flight_recorder(fr)
+    fr.record_step(41, loss=1.0)
+    prev_hook = sys.excepthook
+    fr.install(enable_faulthandler=False)
+    try:
+        assert sys.excepthook is not prev_hook
+        # simulate the interpreter dying on an uncaught error
+        try:
+            raise ValueError("boom at step 41")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        doc = FR.load_dump(fr.default_path())
+        assert doc["reason"] == "unhandled_exception"
+        assert "ValueError: boom at step 41" in doc["exception"]
+        assert doc["steps"][-1]["step"] == 41
+    finally:
+        fr.uninstall()
+    assert sys.excepthook is prev_hook          # chain restored
+
+
+def test_faulthandler_sidecar(tmp_path):
+    import faulthandler
+    fr = FR.FlightRecorder(dump_dir=str(tmp_path))
+    fr.install(excepthook=False, enable_faulthandler=True)
+    try:
+        assert faulthandler.is_enabled()
+        sidecar = fr.default_path(suffix=".traceback")
+        assert os.path.exists(sidecar)
+    finally:
+        fr.uninstall()
+        faulthandler.enable()   # restore pytest's own handler
+
+
+# ---------------------------------------------------------------------------
+# TrainStep integration
+# ---------------------------------------------------------------------------
+
+def test_monitor_off_zero_recorder_writes():
+    """Both FLAGS_monitor and FLAGS_flight_recorder off: the hot path
+    never touches the recorder (same contract as the metrics registry)."""
+    step = _linear_step()
+    x, y = _batch()
+    fr = FR.get_flight_recorder()
+    before = fr.record_count
+    for _ in range(4):
+        step(x, y)
+    assert fr.record_count == before
+    assert fr.steps == []
+
+
+def test_flag_records_steps_without_monitor():
+    x, y = _batch()
+    with flag_scope("flight_recorder", True):
+        step = _linear_step()
+        for _ in range(3):
+            step(x, y)
+    fr = FR.get_flight_recorder()
+    steps = fr.steps
+    assert [r["step"] for r in steps] == [1, 2, 3]
+    assert all(r["kind"] == "step" for r in steps)
+    # monitor off -> timings unknown, loss still held (read at dump time)
+    assert steps[0]["wall_ms"] is None
+    events = fr.events
+    assert events and events[0]["event"] == "compile"
+    doc = json.loads(open(fr.dump()).read())
+    assert isinstance(doc["steps"][0]["loss"], float)
+
+
+def test_monitor_flag_also_records_with_timings():
+    x, y = _batch()
+    with flag_scope("monitor", True):
+        step = _linear_step()
+        step(x, y)
+    steps = FR.get_flight_recorder().steps
+    assert len(steps) == 1
+    assert steps[0]["wall_ms"] > 0
+    assert steps[0]["dispatch_ms"] > 0
+
+
+def test_grad_accum_records_microsteps_and_apply():
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    step = TrainStep(m, _mse, SGD(learning_rate=0.1,
+                                  parameters=m.parameters()),
+                     grad_accum_steps=2)
+    x, y = _batch()
+    with flag_scope("flight_recorder", True):
+        for _ in range(4):
+            step(x, y)
+    kinds = [r["kind"] for r in FR.get_flight_recorder().steps]
+    assert kinds == ["accum", "apply", "accum", "apply"]
+
+
+def test_watchdog_trip_dumps_flight_recorder(tmp_path):
+    """Acceptance: a forced NaN-watchdog trip leaves a parseable dump
+    naming the trip step."""
+    step = _linear_step(check_numerics=True)
+    x, y = _batch()
+    step(x, y)
+    step(x, y)
+    xbad = x.copy()
+    xbad[0, 0] = np.inf
+    with pytest.raises(NonFiniteError) as ei:
+        step(xbad, y)
+    assert "flight recorder dump:" in str(ei.value)
+    dumps = glob.glob(os.path.join(str(tmp_path), "flight_recorder_*.json"))
+    assert len(dumps) == 1                       # conftest routed dir here
+    doc = FR.load_dump(dumps[0])
+    assert doc["reason"] == "nan_watchdog"
+    assert doc["trip_step"] == 3                 # the step that tripped
+    assert doc["offender"] == "bias"
+    trip_events = [e for e in doc["events"] if e["event"] == "trip"]
+    assert trip_events and trip_events[0]["step"] == 3
+    # fingerprint rides along even when the ring was otherwise cold
+    assert doc["fingerprint"]["jax_version"]
+
+
+def test_collectives_recorded_as_events():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1])
+    x = jnp.ones((2, 4), jnp.float32)
+    C.all_reduce(x, group=g)                     # recorder off: no event
+    assert FR.get_flight_recorder().events == []
+    with flag_scope("flight_recorder", True):
+        C.all_reduce(x, group=g)
+    events = FR.get_flight_recorder().events
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["event"] == "collective"
+    assert ev["op"] == "all_reduce"
+    assert ev["bytes"] == x.nbytes
+    assert ev["nranks"] == 2
+
+
+# ---------------------------------------------------------------------------
+# report rendering
+# ---------------------------------------------------------------------------
+
+def test_monitor_report_flight_mode(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "monitor_report", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "monitor_report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    fr = FR.FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    for i in range(5):
+        fr.record_step(i, loss=0.5 - 0.1 * i, wall_ms=2.0,
+                       dispatch_ms=1.0)
+    fr.record_event("recompile", kind="step", step=3)
+    path = fr.dump(reason="nan_watchdog", trip_step=4)
+    out = report.render_flight(FR.load_dump(path), last=3)
+    assert "Flight recorder dump" in out
+    assert "nan_watchdog" in out
+    assert "trip at step 4" in out
+    assert "recompile" in out
+    assert "Step records (last 3 of 5" in out
+    assert "jax_version=" in out
+    # CLI end-to-end
+    assert report.main(["--flight", path]) == 0
+    assert report.main(["--flight", str(tmp_path / "missing.json")]) == 2

@@ -1,0 +1,351 @@
+"""HBM memory accounting + per-program cost attribution (ISSUE 4):
+memory_analysis plumbing through TrainStep.stats(), the OOM pre-flight
+check on both sides of the threshold, live-buffer census attribution,
+leak-growth detection, the shared cost_analysis normalization, and the
+device.cuda memory shims."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.cost_model import (CostModel, device_peak_flops,
+                                   normalize_cost_analysis)
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.monitor import memory as M
+from paddle_tpu.monitor import scoped_registry
+from paddle_tpu.optimizer import SGD, AdamW
+
+
+def _mse(layer, x, y):
+    return ((layer(x) - y) ** 2).mean()
+
+
+def _linear_step(optimizer=None, **kw):
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    opt = optimizer or SGD(learning_rate=0.1, parameters=m.parameters())
+    return TrainStep(m, _mse, opt, **kw)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(8, 4).astype(np.float32),
+            rng.rand(8, 2).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# per-program attribution through TrainStep.stats()
+# ---------------------------------------------------------------------------
+
+def test_train_step_program_attribution():
+    step = _linear_step()
+    x, y = _batch()
+    step(x, y)
+    prog = step.stats()["programs"]
+    assert "step" in prog
+    p = prog["step"]
+    assert p["flops"] > 0
+    assert p["bytes_accessed"] > 0
+    assert p["arithmetic_intensity"] > 0
+    assert p["peak_hbm_bytes"] > 0
+    assert p["argument_bytes"] > 0
+    # the peak estimate decomposes into the memory_analysis parts
+    assert p["peak_hbm_bytes"] <= (p["argument_bytes"] + p["output_bytes"]
+                                   + p["temp_bytes"]
+                                   + p["generated_code_bytes"])
+    # CPU test backend: no known peak FLOP/s, so no MFU fiction
+    assert p["mfu"] is None
+
+
+def test_grad_accum_programs_attributed_separately():
+    paddle.seed(7)
+    m = nn.Linear(4, 2)
+    step = TrainStep(m, _mse, SGD(learning_rate=0.1,
+                                  parameters=m.parameters()),
+                     grad_accum_steps=2)
+    x, y = _batch()
+    step(x, y)
+    step(x, y)
+    prog = step.stats()["programs"]
+    assert {"accum", "apply"} <= set(prog)
+    assert prog["accum"]["flops"] > 0
+    # the apply program folds the optimizer update in: strictly more work
+    assert prog["apply"]["flops"] > prog["accum"]["flops"]
+
+
+def test_scan_gpt_attribution_with_monitor_off_zero_writes():
+    """Acceptance pin: the scan-GPT fixture reports non-zero flops and a
+    peak-HBM estimate for the train program kind while FLAGS_monitor off
+    costs ZERO registry writes (same contract as the PR 3 stats)."""
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       GPTPretrainingCriterion, gpt_tiny)
+    paddle.seed(3)
+    model = GPTForPretraining(gpt_tiny(num_layers=3, scan_layers=True))
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (2, 16)).astype(np.int32)
+    labels = rng.randint(0, 256, (2, 16)).astype(np.int32)
+    with scoped_registry() as reg:
+        step = TrainStep(model, loss_fn,
+                         AdamW(learning_rate=1e-3,
+                               parameters=model.parameters()))
+        before = reg.write_count
+        for _ in range(3):
+            loss = step(ids, labels)
+        assert np.isfinite(float(loss))
+        assert reg.write_count == before
+        assert reg.names() == []
+    prog = step.stats()["programs"]["step"]
+    assert prog["flops"] > 0
+    assert prog["peak_hbm_bytes"] > 0
+
+
+def test_monitor_on_publishes_attribution_gauges():
+    x, y = _batch()
+    with scoped_registry() as reg:
+        with flag_scope("monitor", True):
+            step = _linear_step()
+            step(x, y)
+        g = reg.gauge("train_step_program_flops")
+        assert g.value(kind="step") > 0
+        assert reg.gauge("train_step_program_peak_hbm_bytes"
+                         ).value(kind="step") > 0
+
+
+# ---------------------------------------------------------------------------
+# cost_analysis normalization + CostModel (satellite)
+# ---------------------------------------------------------------------------
+
+def test_normalize_cost_analysis_shapes():
+    assert normalize_cost_analysis(None) == {}
+    d = normalize_cost_analysis({"flops": 4.0, "bytes accessed": 2.0})
+    assert d == {"flops": 4.0, "bytes accessed": 2.0}
+    # list-of-dicts (older jax): numeric keys summed across computations
+    merged = normalize_cost_analysis(
+        [{"flops": 3.0, "bytes accessed": 1.0}, {"flops": 2.0},
+         None, {"utilization": "n/a"}])
+    assert merged["flops"] == 5.0
+    assert merged["bytes accessed"] == 1.0
+    assert "utilization" not in merged
+
+
+def test_cost_model_profile_measure_and_attribute():
+    import jax.numpy as jnp
+    cm = CostModel()
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((32, 32), jnp.float32)
+    r = cm.profile_measure(f, (a, a), iters=3, warmup=1)
+    assert r["flops"] > 0
+    assert r["bytes_accessed"] > 0
+    assert r["wall_ms"] > 0
+    assert r["achieved_tflops"] > 0
+    import jax
+    lowered = jax.jit(f).lower(a, a)
+    attr = cm.attribute(lowered)
+    assert attr["flops"] == r["flops"]
+    assert attr["arithmetic_intensity"] > 0
+
+
+def test_device_peak_flops_unknown_chip():
+    # CPU test backend: unknown chip -> None (or the caller's default)
+    assert device_peak_flops() is None
+    assert device_peak_flops(default=1e12) == 1e12
+    cm = CostModel()
+    assert cm.mfu(1e9, 0.01) is None
+    assert cm.mfu(1e9, 0.01, peak_flops=1e12) == pytest.approx(1e-1)
+
+
+# ---------------------------------------------------------------------------
+# ProgramMemory + pre-flight
+# ---------------------------------------------------------------------------
+
+def test_program_memory_peak_arithmetic():
+    pm = M.ProgramMemory("step", argument_bytes=100, output_bytes=50,
+                         temp_bytes=30, alias_bytes=40,
+                         generated_code_bytes=10)
+    assert pm.peak_bytes == 100 + 50 + 30 + 10 - 40
+    assert pm.as_dict()["peak_bytes"] == pm.peak_bytes
+    # aliasing can exceed the sum on degenerate stats; clamp at zero
+    assert M.ProgramMemory("x", alias_bytes=999).peak_bytes == 0
+
+
+def test_preflight_off_by_default():
+    pm = M.ProgramMemory("step", argument_bytes=1 << 40)
+    # no action flag set -> no check, regardless of how big the program is
+    assert M.preflight_check(pm, limit_bytes=1) is None
+
+
+def test_preflight_warn_and_raise_both_sides():
+    pm = M.ProgramMemory("step", argument_bytes=1 << 20)   # 1 MiB
+    # fits: below the limit -> result, no warning
+    import warnings as W
+    with W.catch_warnings():
+        W.simplefilter("error")
+        r = M.preflight_check(pm, limit_bytes=2 << 20, action="warn")
+    assert r == {"estimate_bytes": 1 << 20, "limit_bytes": 2 << 20,
+                 "fits": True, "kind": "step"}
+    # over the limit: warn mode warns and still returns the numbers
+    with scoped_registry() as reg:
+        with pytest.warns(RuntimeWarning, match="expected to OOM"):
+            r = M.preflight_check(pm, limit_bytes=1 << 19, action="warn")
+        assert r["fits"] is False
+        assert reg.counter("memory_preflight_failures_total"
+                           ).value(kind="step") == 1
+    # raise mode raises with the numbers attached
+    with pytest.raises(M.MemoryBudgetError) as ei:
+        M.preflight_check(pm, limit_bytes=1 << 19, action="raise")
+    assert ei.value.estimate_bytes == 1 << 20
+    assert ei.value.limit_bytes == 1 << 19
+
+
+def test_preflight_flag_gated_through_train_step():
+    x, y = _batch()
+    # tiny explicit budget + raise -> compiling the step program trips
+    with flag_scope("memory_preflight", "raise"), \
+            flag_scope("memory_preflight_limit_mb", 1):
+        step = _linear_step()
+        with pytest.raises(M.MemoryBudgetError):
+            # Linear(4,2) won't exceed 1 MiB of args/temps... make it
+            big = np.zeros((1 << 17, 4), np.float32)        # 2 MiB batch
+            step(big, np.zeros((1 << 17, 2), np.float32))
+    # generous budget: the same config sails through
+    with flag_scope("memory_preflight", "raise"), \
+            flag_scope("memory_preflight_limit_mb", 1 << 14):
+        step = _linear_step()
+        step(x, y)
+
+
+def test_unknown_preflight_action_rejected():
+    pm = M.ProgramMemory("step", argument_bytes=1)
+    with pytest.raises(ValueError, match="memory_preflight"):
+        M.preflight_check(pm, limit_bytes=1, action="explode")
+
+
+# ---------------------------------------------------------------------------
+# live-buffer census + leak detection
+# ---------------------------------------------------------------------------
+
+def test_census_attributes_params_optimizer_buffers():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 8), nn.BatchNorm1D(8))
+    opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = TrainStep(m, _mse, opt)
+    rng = np.random.RandomState(0)
+    step(rng.rand(4, 16).astype(np.float32),
+         rng.rand(4, 8).astype(np.float32))
+    census = M.live_buffer_census(step)
+    param_bytes = sum(int(v.nbytes) for v in step.params.values())
+    assert census["params"]["bytes"] == param_bytes
+    assert census["params"]["count"] == len(step.params)
+    assert census["optimizer"]["bytes"] > 0        # AdamW m/v slots
+    assert census["buffers"]["bytes"] > 0          # BN running stats
+    assert census["total"]["bytes"] >= (census["params"]["bytes"]
+                                        + census["optimizer"]["bytes"]
+                                        + census["buffers"]["bytes"])
+    # without a train step everything floats is 'activations'
+    anon = M.live_buffer_census()
+    assert anon["params"]["bytes"] == 0
+    assert anon["total"]["bytes"] == census["total"]["bytes"]
+
+
+def test_leak_monitor_flags_monotonic_growth_only():
+    leak = M.LeakMonitor(window=3, tolerance_bytes=100)
+    base = 10_000
+    # flat: never suspicious
+    for _ in range(6):
+        assert leak.observe(base) is False
+    # monotonic growth above tolerance: trips (warn + counter)
+    with scoped_registry() as reg:
+        with pytest.warns(RuntimeWarning, match="leak suspected"):
+            tripped = [leak.observe(base + i * 200) for i in range(1, 5)]
+        assert tripped[-1] is True
+        assert leak.suspected >= 1
+        assert reg.counter("memory_leak_suspected_total").value() >= 1
+    # growth below tolerance: quiet
+    quiet = M.LeakMonitor(window=3, tolerance_bytes=10_000)
+    assert not any(quiet.observe(base + i * 10) for i in range(1, 6))
+    # non-monotonic (sawtooth): quiet
+    saw = M.LeakMonitor(window=3, tolerance_bytes=0)
+    vals = [base, base + 500, base - 500, base + 1000, base - 1000]
+    assert not any(saw.observe(v) for v in vals)
+    with pytest.raises(ValueError):
+        M.LeakMonitor(window=1)
+
+
+def test_memory_summary_renders():
+    step = _linear_step()
+    x, y = _batch()
+    step(x, y)
+    text = M.memory_summary(step)
+    assert "memory summary" in text
+    assert "compiled programs" in text
+    assert "step" in text
+    assert "live buffers" in text
+    assert "params" in text
+    # also renders without a train step (process-global program table)
+    assert "live buffers" in M.memory_summary()
+
+
+def test_publish_census_gauges():
+    with scoped_registry() as reg:
+        census = M.publish_census()
+        g = reg.gauge("live_buffer_bytes")
+        assert g.value(category="total") == census["total"]["bytes"]
+        assert reg.gauge("live_buffer_count").value(category="total") \
+            == census["total"]["count"]
+
+
+def test_monitor_report_memory_section(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "monitor_report", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "monitor_report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    from paddle_tpu.monitor import MetricsRegistry, load_jsonl
+    reg = MetricsRegistry()
+    reg.gauge("train_step_program_peak_hbm_bytes").set(1 << 30,
+                                                       kind="step")
+    reg.gauge("train_step_program_flops").set(1e12, kind="step")
+    reg.gauge("train_step_program_bytes_accessed").set(1e9, kind="step")
+    reg.gauge("live_buffer_bytes").set(12345, category="params")
+    reg.gauge("live_buffer_count").set(7, category="params")
+    path = str(tmp_path / "m.jsonl")
+    reg.dump_jsonl(path)
+    out = report.render(load_jsonl(path), memory=True)
+    assert "Program HBM budgets" in out
+    assert "1.0 GiB" in out
+    assert "Live-buffer census" in out
+    assert "params" in out
+    # without --memory the gauges still show up (in 'Other metrics')
+    out2 = report.render(load_jsonl(path))
+    assert "Program HBM budgets" not in out2
+    assert "train_step_program_flops" in out2
+
+
+# ---------------------------------------------------------------------------
+# device.cuda memory shims (satellite)
+# ---------------------------------------------------------------------------
+
+def test_device_cuda_memory_shims_graceful_on_cpu():
+    from paddle_tpu.device import cuda
+    # CPU backend publishes no memory_stats: every shim degrades to 0
+    # instead of raising (reference CPU behavior)
+    assert cuda.memory_allocated() == 0
+    assert cuda.max_memory_allocated() == 0
+    assert cuda.memory_reserved() == 0
+    assert cuda.max_memory_reserved() == 0
+    assert cuda.reset_max_memory_allocated() is None
+    assert cuda.max_memory_allocated() == 0
+    assert M.device_memory_stats() is None
+    assert M.device_hbm_bytes() is None

@@ -10,10 +10,11 @@ Usage:
     python tools/check_bench.py BENCH_r04.json BENCH_r05.json
     python tools/check_bench.py --tolerance 0.15 old.json new.json
 
-Metric direction is derived from the unit: time-like units (ms, s, us)
-regress when they grow; rate-like units (tokens/s, img/s, steps/s)
-regress when they shrink. The default tolerance (10%) absorbs normal
-tunnel noise; bench.py's min-of-k timing keeps the noise floor below it.
+Metric direction is derived from the unit: cost-like units (ms, s, us,
+bytes — compile time, step time, peak-HBM estimates) regress when they
+grow; rate-like units (tokens/s, img/s, steps/s) regress when they
+shrink. The default tolerance (10%) absorbs normal tunnel noise;
+bench.py's min-of-k timing keeps the noise floor below it.
 
 Exit code: 0 = no regression, 1 = regression(s), 2 = usage/parse error.
 """
@@ -25,7 +26,11 @@ import sys
 from typing import Dict, List, Optional
 
 DEFAULT_TOLERANCE = 0.10
-_TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds"}
+# cost-like units: growth is the regression (memory units gate the
+# *_peak_hbm_bytes budget lines the same way time units gate compile/step
+# time)
+_TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
+               "mib", "gib"}
 
 
 def _metric_list(record) -> List[dict]:

@@ -10,10 +10,19 @@ stream — prints:
 - compile/recompile counters (TrainStep jit entries + the process-wide
   jax backend-compile / persistent-cache / scan-trace gauges);
 - comms traffic: bytes/ops/mean dispatch latency by (op, group);
+- with ``--memory``: per-program HBM budget table
+  (``train_step_program_*`` gauges) + the live-buffer census
+  (``live_buffer_bytes`` by category, from monitor.memory);
 - everything else (counters/gauges) as a flat table.
 
+``--flight`` switches input format entirely: the argument is a crash
+flight-recorder dump (monitor/flight_recorder.py JSON) and the report
+shows trip reason, environment fingerprint, the event log and the
+last-N step records.
+
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory]
+    python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
 
 Exit code: 0 on success (including an empty report), 2 on usage/read
 errors. Append-only input is expected: the NEWEST sample per
@@ -62,7 +71,87 @@ def _table(title: str, headers: List[str],
     return lines
 
 
-def render(rows: List[dict], top: int = 10) -> str:
+def _memory_section(latest, used) -> List[str]:
+    """--memory: per-program HBM budgets + the live-buffer census."""
+    prog: Dict[str, dict] = {}
+    for key, row in latest.items():
+        name, labels = key
+        if name.startswith("train_step_program_"):
+            used.add(key)
+            kind = dict(labels).get("kind", "-")
+            prog.setdefault(kind, {})[
+                name[len("train_step_program_"):]] = row.get("value", 0.0)
+    p_rows = []
+    for kind in sorted(prog):
+        d = prog[kind]
+        flops, acc = d.get("flops", 0.0), d.get("bytes_accessed", 0.0)
+        p_rows.append([kind, _fmt_bytes(d.get("peak_hbm_bytes", 0.0)),
+                       f"{flops:.3e}", _fmt_bytes(acc),
+                       f"{flops / acc:.1f}" if acc else "-"])
+    out = _table("Program HBM budgets (static, per kind)",
+                 ["kind", "peak HBM est.", "flops", "bytes accessed",
+                  "arith. int."], p_rows)
+    c_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if name in ("live_buffer_bytes", "live_buffer_count"):
+            used.add(key)
+            if name == "live_buffer_bytes":
+                cat = dict(labels).get("category", "-")
+                n = latest.get(("live_buffer_count", labels), {})
+                c_rows.append([cat,
+                               _fmt_bytes(latest[key].get("value", 0.0)),
+                               f"{n.get('value', 0):g}"])
+    out += _table("Live-buffer census", ["category", "bytes", "arrays"],
+                  c_rows)
+    return out
+
+
+def render_flight(doc: dict, last: int = 10) -> str:
+    """Render a flight-recorder dump: trip reason, fingerprint, events,
+    last-N step records."""
+    lines = ["== Flight recorder dump =="]
+    reason = doc.get("reason", "?")
+    trip = doc.get("trip_step")
+    lines.append(f"reason: {reason}"
+                 + (f" (trip at step {trip})" if trip is not None else ""))
+    if doc.get("exception"):
+        lines.append(f"exception: {doc['exception']}")
+    fp = doc.get("fingerprint") or {}
+    lines.append("fingerprint: " + (", ".join(
+        f"{k}={fp[k]}" for k in sorted(fp) if k != "argv") or "(none)"))
+    lines.append("")
+    ev = doc.get("events") or []
+    e_rows = [[str(r.get("event", "?")),
+               str(r.get("kind", r.get("op", "-"))),
+               str(r.get("step", "-")),
+               ", ".join(f"{k}={v}" for k, v in sorted(r.items())
+                         if k not in ("event", "kind", "op", "step",
+                                      "ts"))]
+              for r in ev[-last:]]
+    lines += _table(f"Events (last {min(last, len(ev))} of {len(ev)})",
+                    ["event", "what", "step", "detail"], e_rows)
+    steps = doc.get("steps") or []
+    s_rows = []
+    for r in steps[-last:]:
+        def num(v, fmt="{:.3f}"):
+            return fmt.format(v) if isinstance(v, (int, float)) \
+                else (str(v) if v is not None else "-")
+        s_rows.append([str(r.get("step", "-")), str(r.get("kind", "-")),
+                       num(r.get("loss"), "{:.5f}"),
+                       num(r.get("wall_ms")), num(r.get("dispatch_ms")),
+                       str(r.get("seed", "-"))])
+    lines += _table(f"Step records (last {min(last, len(steps))} of "
+                    f"{len(steps)}, ring capacity "
+                    f"{doc.get('capacity', '?')})",
+                    ["step", "kind", "loss", "wall ms", "dispatch ms",
+                     "seed"], s_rows)
+    if not ev and not steps:
+        lines.append("(no step records or events in this dump)")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render(rows: List[dict], top: int = 10, memory: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -120,6 +209,10 @@ def render(rows: List[dict], top: int = 10) -> str:
     out += _table("Collectives (eager dispatch)",
                   ["op/group", "ops", "bytes", "mean dispatch ms"], m_rows)
 
+    # -- memory (--memory) -------------------------------------------------
+    if memory:
+        out += _memory_section(latest, used)
+
     # -- everything else ---------------------------------------------------
     o_rows = []
     for key in sorted(latest):
@@ -140,26 +233,50 @@ def render(rows: List[dict], top: int = 10) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    top = 10
-    if "--top" in argv:
-        i = argv.index("--top")
+
+    def int_opt(flag: str, default: int) -> Optional[int]:
+        if flag not in argv:
+            return default
+        i = argv.index(flag)
         try:
-            top = int(argv[i + 1])
+            v = int(argv[i + 1])
         except (IndexError, ValueError):
-            print("--top needs an int", file=sys.stderr)
-            return 2
+            print(f"{flag} needs an int", file=sys.stderr)
+            return None
         del argv[i:i + 2]
+        return v
+
+    top = int_opt("--top", 10)
+    last = int_opt("--last", 10)
+    if top is None or last is None:
+        return 2
+    flight = "--flight" in argv
+    if flight:
+        argv.remove("--flight")
+    memory = "--memory" in argv
+    if memory:
+        argv.remove("--memory")
     if len(argv) != 1:
         print(__doc__, file=sys.stderr)
         return 2
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    if flight:
+        import json
+        try:
+            with open(argv[0]) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
+            return 2
+        print(render_flight(doc, last=last), end="")
+        return 0
     try:
-        sys.path.insert(0, __file__.rsplit("/", 2)[0])
         from paddle_tpu.monitor import load_jsonl
         rows = load_jsonl(argv[0])
     except OSError as e:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
-    print(render(rows, top=top), end="")
+    print(render(rows, top=top, memory=memory), end="")
     return 0
 
 
