@@ -567,6 +567,22 @@ def main() -> None:
     log(f"devices: {jax.devices()}")
     log(f"compilation cache: {jax.config.jax_compilation_cache_dir} "
         "(compile+step1 timings below collapse on warm runs)")
+    if "--chaos" in sys.argv:
+        # deterministic fault injection for recovery drills: e.g.
+        #   bench.py --quick --chaos grad.nonfinite@3
+        # (site spec grammar: paddle_tpu/testing/chaos.py; fires land in
+        # the flight-recorder recovery timeline)
+        from paddle_tpu.core.flags import get_flag
+        from paddle_tpu.testing import chaos
+        i = sys.argv.index("--chaos")
+        spec = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""
+        if not spec or spec.startswith("-"):
+            sys.exit("--chaos needs a spec: site[@N|:p][*k][,...] — "
+                     "sites: " + ", ".join(sorted(chaos.SITES)))
+        seed = int(get_flag("chaos_seed"))
+        chaos.configure(spec, seed=seed)
+        paddle.set_flags({"flight_recorder": True})
+        log(f"chaos armed: {spec} (seed={seed}; flight recorder on)")
     full = "--quick" not in sys.argv
     metrics = []
 

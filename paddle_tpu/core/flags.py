@@ -171,6 +171,37 @@ define_flag("flight_recorder_dir", "",
 define_flag("flight_recorder_capacity", 256,
             "Ring-buffer size of the flight recorder: how many recent "
             "step records survive to a crash dump.")
+define_flag("checkpoint_verify", "manifest",
+            "Checkpoint validation level for distributed.checkpoint "
+            "restores and latest_step scans. 'manifest' (default) = a "
+            "committed manifest must exist and every file it lists must "
+            "be present with the recorded size (catches uncommitted and "
+            "torn directories); 'full' = additionally re-checksum every "
+            "file against the manifest CRCs (catches silent bit "
+            "corruption, costs one read of the checkpoint); 'off' = "
+            "existence check only (restores legacy pre-manifest "
+            "checkpoints). CRCs are RECORDED at commit time only under "
+            "'full' (they cost a full re-read of the staged tree); "
+            "manifests without CRCs still verify at 'manifest' level.")
+define_flag("collective_timeout_s", 0.0,
+            "Watchdog timeout (seconds) for EAGER collectives in "
+            "distributed.collective: a dispatch that does not return "
+            "within the budget raises CollectiveTimeoutError (with a "
+            "collective_timeout flight-recorder event) instead of "
+            "hanging the controller forever. 0 (default) = no watchdog, "
+            "direct dispatch. The budget covers the whole dispatch "
+            "including a first-call trace+compile — set it well above "
+            "the cold-start time.")
+define_flag("chaos", "",
+            "Deterministic fault-injection spec for "
+            "paddle_tpu.testing.chaos (tests and bench.py --chaos): "
+            "comma-separated 'site[@N|:prob][*times]' entries, e.g. "
+            "'ckpt.write.torn@2,collective.hang:0.1'. Empty (default) = "
+            "no injection, zero probe overhead.")
+define_flag("chaos_seed", 0,
+            "Seed for probability-based chaos sites: the same "
+            "(seed, site, occurrence) triple always makes the same "
+            "fire/no-fire decision, so chaos runs replay exactly.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..testing import chaos
 from . import env
 
 __all__ = [
@@ -50,7 +51,30 @@ __all__ = [
     "all_reduce", "all_gather", "all_gather_object", "reduce", "broadcast",
     "scatter", "alltoall", "send", "recv", "barrier", "wait",
     "all_reduce_arrays", "is_initialized", "get_world_size_of_group",
+    "CollectiveTimeoutError",
 ]
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """An eager collective dispatch exceeded ``FLAGS_collective_timeout_s``.
+
+    The reference analogue is an NCCL communicator watchdog abort
+    (NCCL_ASYNC_ERROR_HANDLING): a hung ring must become a structured,
+    catchable error on the controller instead of a silent stall. Carries
+    the op name, group axis and the budget for supervisors that restart
+    on comm failure."""
+
+    def __init__(self, op: str, group: "Group", timeout_s: float):
+        super().__init__(
+            f"collective {op!r} on group {group.axis_name!r} "
+            f"(nranks={group.nranks}) did not complete within "
+            f"{timeout_s:g}s (FLAGS_collective_timeout_s). The dispatch "
+            "thread is abandoned; on a real hang, restart from the last "
+            "committed checkpoint (distributed.checkpoint."
+            "CheckpointManager).")
+        self.op = op
+        self.group_axis = group.axis_name
+        self.timeout_s = timeout_s
 
 
 class ReduceOp:
@@ -277,6 +301,76 @@ def _comm_trace(op: str, group: Group, x, cache_key=None):
         pass
 
 
+def _run_collective(op: str, group: Group, fn, *args):
+    """Dispatch an eager collective under the watchdog.
+
+    With ``FLAGS_collective_timeout_s`` unset (default) and no chaos
+    armed this is a direct call — zero overhead. With a budget, the
+    dispatch runs on a daemon worker thread and a wall-clock watchdog
+    converts a stall into :class:`CollectiveTimeoutError`, recording a
+    ``collective_timeout`` flight-recorder event and a registry counter.
+    XLA cannot cancel an in-flight collective from python, so the hung
+    thread is abandoned (exactly what the NCCL watchdog does before
+    aborting the communicator) — the caller's recovery is a restart from
+    the last committed checkpoint. The budget covers the whole dispatch,
+    including a first-call trace+compile; set it well above cold-start.
+
+    Chaos site ``collective.hang`` blocks the worker (bounded,
+    cancellable) to prove the watchdog path deterministically."""
+    from ..core.flags import get_flag
+    timeout_s = float(get_flag("collective_timeout_s") or 0.0)
+    hang = chaos.active() and chaos.probe("collective.hang")
+    if timeout_s <= 0.0 and not hang:
+        return fn(*args)
+    if hang and timeout_s <= 0.0:
+        # a hang with no watchdog budget would block the controller (the
+        # faithful simulation) — useless in any harness; fail loudly at
+        # the misconfiguration instead
+        raise RuntimeError(
+            "chaos site 'collective.hang' fired but "
+            "FLAGS_collective_timeout_s is unset — set a timeout budget "
+            "so the watchdog (the thing this site exists to exercise) "
+            "can convert the hang into CollectiveTimeoutError")
+
+    result: dict = {}
+    done = threading.Event()
+
+    def worker():
+        try:
+            if hang:
+                chaos.hang_loop(max(timeout_s, 1.0) * 20 + 60.0)
+            result["value"] = fn(*args)
+        except BaseException as e:     # surfaces on the caller's thread
+            result["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"collective-{op}")
+    t.start()
+    if not done.wait(timeout_s if timeout_s > 0.0 else None):
+        try:
+            from ..monitor import get_registry
+            get_registry().counter(
+                "collective_timeouts_total",
+                "eager collective watchdog trips").inc(
+                    op=op, group=group.axis_name)
+        except Exception:
+            pass
+        try:
+            from ..monitor import flight_recorder as _flight
+            if _flight.enabled():
+                _flight.get_flight_recorder().record_event(
+                    "collective_timeout", op=op, group=group.axis_name,
+                    nranks=group.nranks, timeout_s=timeout_s)
+        except Exception:
+            pass
+        raise CollectiveTimeoutError(op, group, timeout_s)
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
 def _check_stacked(arr, group: Group, opname: str):
     if arr.ndim == 0 or arr.shape[0] != group.nranks:
         raise ValueError(
@@ -320,7 +414,8 @@ def all_reduce(tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None,
         return jnp.broadcast_to(_LAX_REDUCE[op](s, ax), s.shape)
 
     with _comm_trace("all_reduce", g, x, ("all_reduce", op)):
-        out = _eager_shardmap(g, ("all_reduce", op), body)(x)
+        out = _run_collective(
+            "all_reduce", g, _eager_shardmap(g, ("all_reduce", op), body), x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -382,7 +477,9 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
             return jax.lax.all_gather(s[0], ax)[None]
 
         with _comm_trace("all_gather", g, x, ("all_gather",)):
-            out = _eager_shardmap(g, ("all_gather",), body)(x)
+            out = _run_collective(
+                "all_gather", g, _eager_shardmap(g, ("all_gather",), body),
+                x)
         return _rewrap(out, tensor_or_list)
 
     # list-filling parity form
@@ -454,7 +551,9 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
         return jnp.where(idx == dst_local, red, s)
 
     with _comm_trace("reduce", g, x, ("reduce", op, dst_local)):
-        out = _eager_shardmap(g, ("reduce", op, dst_local), body)(x)
+        out = _run_collective(
+            "reduce", g, _eager_shardmap(g, ("reduce", op, dst_local), body),
+            x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -486,7 +585,9 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
         return jax.lax.all_gather(s[0], ax)[src_local][None]
 
     with _comm_trace("broadcast", g, x, ("broadcast", src_local)):
-        out = _eager_shardmap(g, ("broadcast", src_local), body)(x)
+        out = _run_collective(
+            "broadcast", g,
+            _eager_shardmap(g, ("broadcast", src_local), body), x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -560,7 +661,8 @@ def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None
                                   tiled=False).swapaxes(0, 1)
 
     with _comm_trace("alltoall", g, x, ("alltoall",)):
-        out = _eager_shardmap(g, ("alltoall",), body)(x)
+        out = _run_collective(
+            "alltoall", g, _eager_shardmap(g, ("alltoall",), body), x)
     return _rewrap(out, in_tensor_list)
 
 
@@ -621,7 +723,9 @@ def ppermute_shift(x, group: Optional[Group] = None, shift: int = 1):
         return jax.lax.ppermute(s, ax, perm)
 
     with _comm_trace("ppermute_shift", g, arr, ("ppermute", shift)):
-        out = _eager_shardmap(g, ("ppermute", shift), body)(arr)
+        out = _run_collective(
+            "ppermute_shift", g,
+            _eager_shardmap(g, ("ppermute", shift), body), arr)
     return _rewrap(out, x)
 
 
@@ -629,7 +733,11 @@ def barrier(group: Optional[Group] = None):
     """reference: collective.py barrier → barrier op / gloo."""
     if env.get_world_size() > 1:
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        # the cross-HOST sync is the likeliest real-world hang (a dead
+        # peer process): watchdog applies here too
+        _run_collective(
+            "barrier", group or _default_group(),
+            multihost_utils.sync_global_devices, "paddle_tpu_barrier")
         return
     g = group or _default_group()
     if g.nranks > 1:
@@ -651,8 +759,15 @@ def all_reduce_arrays(arrays: List, op: int = ReduceOp.SUM,
     if env.get_world_size() <= 1:
         return list(arrays)
     from jax.experimental import multihost_utils
-    out = []
-    for a in arrays:
-        g = multihost_utils.process_allgather(np.asarray(a))
-        out.append(jnp.asarray(np.sum(g, axis=0)))
-    return out
+
+    def gather_sum():
+        out = []
+        for a in arrays:
+            g = multihost_utils.process_allgather(np.asarray(a))
+            out.append(jnp.asarray(np.sum(g, axis=0)))
+        return out
+
+    # cross-host allgather: a dead peer hangs this forever without the
+    # watchdog — the exact production scenario the timeout exists for
+    return _run_collective("all_reduce_arrays", group or _default_group(),
+                           gather_sum)

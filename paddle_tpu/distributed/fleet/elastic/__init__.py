@@ -72,9 +72,29 @@ class ElasticManager:
     def _hb_path(self, rank):
         return os.path.join(self.root, f"worker_{rank}.hb")
 
+    def _write_marker(self, path: str, payload: str):
+        """One registry-store write. On TPU pods the registry dir is
+        NFS/shared-fs: transient EIO/ESTALE under load is normal, so all
+        store writes go through exponential backoff with jitter (the
+        same helper the HDFS transport uses) — a worker must not be
+        declared dead because one heartbeat write hit a slow NFS
+        server. Write-then-rename keeps readers from seeing a torn
+        heartbeat as a dead worker."""
+        from ..utils.fs import retry_with_backoff
+
+        def attempt():
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+
+        retry_with_backoff(attempt, retries=3, base_delay=0.05,
+                           max_delay=2.0, retry_on=(OSError,),
+                           what=f"elastic store write {path}")
+
     def beat(self):
-        with open(self._hb_path(self.rank), "w") as f:
-            json.dump({"pid": os.getpid(), "ts": time.time()}, f)
+        self._write_marker(self._hb_path(self.rank), json.dumps(
+            {"pid": os.getpid(), "ts": time.time()}))
 
     def alive_workers(self):
         now = time.time()
@@ -92,8 +112,8 @@ class ElasticManager:
         return sorted(alive)
 
     def mark_completed(self):
-        with open(os.path.join(self.root, "COMPLETED"), "w") as f:
-            f.write(str(time.time()))
+        self._write_marker(os.path.join(self.root, "COMPLETED"),
+                           str(time.time()))
 
     # -- state machine (reference: manager.py:324 watch) -------------------
     def watch(self) -> str:

@@ -8,13 +8,56 @@ interface so remote stores slot in without touching training code.
 
 from __future__ import annotations
 
+import logging
 import os
+import random
 import shutil
 import subprocess
-from typing import List, Optional, Tuple
+import time
+from typing import Callable, List, Optional, Tuple, TypeVar
 
 __all__ = ["ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
-           "FSTimeOut", "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient"]
+           "FSTimeOut", "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient",
+           "retry_with_backoff"]
+
+logger = logging.getLogger("paddle_tpu.fs")
+
+_T = TypeVar("_T")
+
+
+def retry_with_backoff(fn: Callable[[], _T], retries: int = 3,
+                       base_delay: float = 0.5, max_delay: float = 30.0,
+                       jitter: float = 0.5,
+                       retry_on: Tuple[type, ...] = (Exception,),
+                       what: str = "", sleep=time.sleep) -> _T:
+    """Run ``fn`` with exponential backoff + jitter on transient failure.
+
+    Replaces the reference's fixed-interval ``sleep_inter`` retry loop
+    (fs.py HDFSClient): fixed-interval retries against a struggling
+    store synchronize every worker's retries into the very thundering
+    herd that is keeping the store struggling. Delay for attempt k is
+    ``min(max_delay, base_delay * 2**k) * (1 + jitter*U[0,1))``; each
+    failed attempt logs one line (store operations are sparse — silence
+    here costs hours of debugging later). Exceptions carrying
+    ``retryable = False`` (permanent failures: missing CLI, bad
+    arguments) re-raise immediately; so do exception types outside
+    ``retry_on``. Used by the HDFS transport and the ElasticManager
+    heartbeat/marker writes."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if not getattr(e, "retryable", True) or attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (2.0 ** attempt))
+            delay *= 1.0 + jitter * random.random()
+            attempt += 1
+            logger.warning(
+                "%s failed (attempt %d/%d): %r — retrying in %.2fs",
+                what or getattr(fn, "__name__", "operation"), attempt,
+                retries + 1, e, delay)
+            sleep(delay)
 
 
 class ExecuteError(Exception):
@@ -169,36 +212,60 @@ class HDFSClient(FS):
     is absent or a command fails."""
 
     def __init__(self, hadoop_home: str, configs: Optional[dict] = None,
-                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000,
+                 retries: int = 3):
         self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
             if hadoop_home else "hadoop"
         self._configs = configs or {}
         self._timeout = time_out / 1000.0
+        # sleep_inter (ms, reference parity) seeds the BASE delay of the
+        # backoff schedule; the fixed-interval loop it named is gone
+        self._base_delay = max(sleep_inter, 1) / 1000.0
+        self._retries = max(0, int(retries))
 
-    def _run(self, *args, probe: bool = False) -> str:
-        """Run a hadoop fs command. `probe=True` is the `-test` mode:
-        return code 1 with empty stderr means "condition false" (not an
-        error) and raises _ProbeFalse; every other failure — missing CLI,
-        permissions, network — still raises, so a broken transport can
-        NEVER masquerade as "file does not exist"."""
+    def _run(self, *args, probe: bool = False,
+             idempotent: bool = True) -> str:
+        """Run a hadoop fs command with retry/backoff. `probe=True` is
+        the `-test` mode: return code 1 with empty stderr means
+        "condition false" (not an error) and raises _ProbeFalse; every
+        other failure — missing CLI, permissions, network — still
+        raises, so a broken transport can NEVER masquerade as "file does
+        not exist". Transient failures (nonzero exit, CLI timeout) are
+        retried with exponential backoff; a missing CLI is permanent and
+        raises immediately. ``idempotent=False`` (mv/put/touchz)
+        disables retry entirely: a timed-out rename may have SUCCEEDED
+        server-side, and re-running it would convert that success into a
+        spurious "source does not exist" failure — those callers must
+        see the first error and decide with a probe."""
         cmd = [self._hadoop, "fs"]
         for k, v in self._configs.items():
             cmd += [f"-D{k}={v}"]
         cmd += list(args)
-        try:
-            out = subprocess.run(cmd, capture_output=True, text=True,
-                                 timeout=self._timeout)
-        except FileNotFoundError as e:
-            raise ExecuteError(
-                f"hadoop CLI not found at {self._hadoop!r}: {e}") from e
-        except subprocess.TimeoutExpired as e:
-            raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
-        if out.returncode != 0:
-            if probe and out.returncode == 1 and not out.stderr.strip():
-                raise _ProbeFalse()
-            raise ExecuteError(
-                f"{' '.join(cmd)} failed: {out.stderr.strip()[:500]}")
-        return out.stdout
+
+        def attempt() -> str:
+            try:
+                out = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=self._timeout)
+            except FileNotFoundError as e:
+                err = ExecuteError(
+                    f"hadoop CLI not found at {self._hadoop!r}: {e}")
+                err.retryable = False      # retrying won't grow a CLI
+                raise err from e
+            except subprocess.TimeoutExpired as e:
+                raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
+            if out.returncode != 0:
+                if probe and out.returncode == 1 and not out.stderr.strip():
+                    raise _ProbeFalse()
+                raise ExecuteError(
+                    f"{' '.join(cmd)} failed: {out.stderr.strip()[:500]}")
+            return out.stdout
+
+        return retry_with_backoff(
+            attempt,
+            retries=self._retries if idempotent else 0,
+            base_delay=self._base_delay,
+            retry_on=(ExecuteError, FSTimeOut),
+            what=" ".join(cmd[:4]) + (" ..." if len(cmd) > 4 else ""))
 
     def ls_dir(self, fs_path):
         out = self._run("-ls", fs_path)
@@ -238,7 +305,7 @@ class HDFSClient(FS):
             return False
 
     def upload(self, local_path, fs_path):
-        self._run("-put", local_path, fs_path)
+        self._run("-put", local_path, fs_path, idempotent=False)
 
     def download(self, fs_path, local_path):
         self._run("-get", fs_path, local_path)
@@ -253,7 +320,7 @@ class HDFSClient(FS):
         return True
 
     def rename(self, fs_src_path, fs_dst_path):
-        self._run("-mv", fs_src_path, fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path, idempotent=False)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
            test_exists=False):
@@ -263,14 +330,14 @@ class HDFSClient(FS):
             if not overwrite:
                 raise FSFileExistsError(f"{fs_dst_path} exists")
             self.delete(fs_dst_path)
-        self._run("-mv", fs_src_path, fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path, idempotent=False)
 
     def touch(self, fs_path, exist_ok=True):
         if self.is_exist(fs_path):
             if exist_ok:
                 return
             raise FSFileExistsError(f"{fs_path} exists")
-        self._run("-touchz", fs_path)
+        self._run("-touchz", fs_path, idempotent=False)
 
     def cat(self, fs_path=None):
         return self._run("-cat", fs_path)

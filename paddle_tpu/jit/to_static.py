@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..core.random import make_rng, trace_rng
 from ..core.tensor import TapeNode, Tensor, is_grad_enabled, no_grad
 from ..nn.layer import Layer
+from ..testing import chaos as _chaos
 from .functional import (bind, buffer_arrays, param_arrays,
                          trainable_param_arrays, unwrap, wrap)
 from .input_spec import InputSpec
@@ -323,7 +324,8 @@ class TrainStep:
                  mesh=None, data_spec=None, zero_axis: Optional[str] = None,
                  grad_accum_steps: Optional[int] = None,
                  grad_accum_avg: Optional[bool] = None,
-                 check_numerics=False):
+                 check_numerics=False,
+                 skip_nonfinite_budget: int = 0):
         from ..distributed import env as dist_env
         self.layer = layer
         self.loss_fn = loss_fn
@@ -374,11 +376,21 @@ class TrainStep:
         # PRE-update params/buffers alive after the step, so donation is
         # off in this mode. Values: False | True/"raise" | "warn".
         self._check_numerics = check_numerics
-        if check_numerics:
+        # skip_nonfinite_budget: graceful degradation on a transient
+        # numeric fault (GradScaler-style, docs/FAULT_TOLERANCE.md). On
+        # a non-finite loss the whole update (params/opt-state/step
+        # count) is ROLLED BACK and training continues; only after N
+        # CONSECUTIVE skips does the trip raise — a single bad batch on
+        # a week-long run is an event, not a crash. Needs the watchdog's
+        # pre-update state alive, so donation is off in this mode too.
+        self.skip_nonfinite_budget = max(0, int(skip_nonfinite_budget))
+        self._consecutive_skips = 0
+        if check_numerics or self.skip_nonfinite_budget:
             self._donate = False
         self._kinds_compiled: set = set()
         self._stats = {"compiles": 0, "recompiles": 0,
-                       "grad_accum_syncs": 0, "nonfinite_trips": 0}
+                       "grad_accum_syncs": 0, "nonfinite_trips": 0,
+                       "nonfinite_skips": 0}
         # per-program-kind attribution (ISSUE 4): cost from
         # lowered.cost_analysis(), HBM budget from
         # compiled.memory_analysis() — captured once per compile (never
@@ -692,7 +704,8 @@ class TrainStep:
             yield
 
     def _watchdog(self, loss, prev_params, prev_buffers, key, flat,
-                  treedef, step_index: int, step_kind: str = "step"):
+                  treedef, step_index: int, step_kind: str = "step",
+                  rollback=None):
         """check_numerics post-step check (eager, outside the compiled
         step). Cost while healthy: ONE scalar readback per step (which
         also synchronizes dispatch — this is a debugging mode). On a trip:
@@ -700,8 +713,17 @@ class TrainStep:
         state with the same RNG key and batch, naming the first (sorted)
         non-finite gradient/parameter. ``step_kind`` disambiguates the
         two step clocks: accum-only trips report the MICROSTEP index,
-        optimizer-update trips the step (optimizer) index."""
+        optimizer-update trips the step (optimizer) index.
+
+        With ``skip_nonfinite_budget`` set, a trip within the budget
+        calls ``rollback`` (restoring the pre-step state the caller
+        captured) and returns instead of raising; the trip still lands
+        in the stats, registry and flight recorder as a
+        ``nonfinite_skip`` event. The budget counts CONSECUTIVE skips —
+        any finite step resets it — and exhaustion raises
+        :class:`NonFiniteError` whatever the check_numerics action is."""
         if bool(jnp.isfinite(loss).all()):
+            self._consecutive_skips = 0
             return
         self._stats["nonfinite_trips"] += 1
         from ..monitor import get_registry
@@ -737,17 +759,52 @@ class TrainStep:
                + " (TrainStep check_numerics watchdog; the in-graph "
                "variant is FLAGS_check_nan_inf)")
         offender = bad_grad or bad_param or "loss"
+        from ..monitor import flight_recorder as _flight
+        budget = self.skip_nonfinite_budget
+        if budget and rollback is not None:
+            self._consecutive_skips += 1
+            if self._consecutive_skips <= budget:
+                # within budget: revert the whole update and continue —
+                # the GradScaler skip model generalized to any
+                # non-finite trip. The event is recorded everywhere a
+                # post-mortem would look, but the run lives.
+                rollback()
+                self._stats["nonfinite_skips"] += 1
+                get_registry().counter(
+                    "nonfinite_skips_total",
+                    "non-finite steps skipped under "
+                    "skip_nonfinite_budget").inc()
+                if _flight.enabled():
+                    _flight.get_flight_recorder().record_event(
+                        "nonfinite_skip", step=step_index,
+                        step_kind=step_kind, offender=offender,
+                        consecutive=self._consecutive_skips,
+                        budget=budget)
+                import warnings
+                warnings.warn(
+                    msg + f"; update skipped and rolled back "
+                    f"({self._consecutive_skips}/{budget} consecutive)",
+                    RuntimeWarning, stacklevel=3)
+                return
+            # exhaustion: roll back too before raising — a supervisor
+            # that catches NonFiniteError and checkpoints for handoff
+            # must persist the last-known-good state, not the NaN update
+            # every within-budget trip carefully reverted
+            rollback()
+            msg += (f"; skip_nonfinite_budget exhausted "
+                    f"({budget} consecutive non-finite steps; state "
+                    "rolled back to the last finite step)")
         # crash forensics: a watchdog trip dumps the flight recorder
         # (ring of recent steps + fingerprint), naming the trip step —
         # best-effort, the NonFiniteError below must win
-        from ..monitor import flight_recorder as _flight
         dump_path = _flight.trip_dump(step=step_index,
                                       reason="nan_watchdog",
                                       offender=offender,
                                       step_kind=step_kind)
         if dump_path:
             msg += f"; flight recorder dump: {dump_path}"
-        if self._check_numerics == "warn":
+        if self._check_numerics == "warn" and not (
+                budget and self._consecutive_skips > budget):
             import warnings
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
             return
@@ -809,8 +866,9 @@ class TrainStep:
                 jnp.zeros_like, self.params)
         key = make_rng("train_step")
         self._micro_count += 1
-        prev = ((self.params, self.buffers) if self._check_numerics
-                else None)
+        watch = bool(self._check_numerics) or self.skip_nonfinite_budget > 0
+        prev = ((self.params, self.buffers, self._acc_grads,
+                 self.opt_state) if watch else None)
         is_update = self._micro_count % self.grad_accum_steps == 0
         if not is_update:
             sig = ("acc", _sig_of(flat)[0], treedef)
@@ -832,6 +890,8 @@ class TrainStep:
                 self.buffers, self._acc_grads, loss = jitted(
                     self.params, self.buffers, self._acc_grads, key, flat)
             dispatch_s = time.perf_counter() - t0 if mon else None
+            if _chaos.active() and _chaos.probe("grad.nonfinite"):
+                loss = jnp.full_like(loss, jnp.nan)
             if mon:
                 self._record_step_metrics(t_wall, dispatch_s,
                                           kind="accum")
@@ -841,9 +901,14 @@ class TrainStep:
                     self._micro_count, loss=loss, kind="accum",
                     dispatch_ms=None if dispatch_s is None
                     else dispatch_s * 1e3)
-            if self._check_numerics:
+            if watch:
+                def rollback():
+                    (self.params, self.buffers, self._acc_grads,
+                     self.opt_state) = prev
+                    self._micro_count -= 1
                 self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
-                               self._micro_count, step_kind="microstep")
+                               self._micro_count, step_kind="microstep",
+                               rollback=rollback)
             return Tensor(loss)
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -886,6 +951,8 @@ class TrainStep:
         else:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss) = out
+        if _chaos.active() and _chaos.probe("grad.nonfinite"):
+            loss = jnp.full_like(loss, jnp.nan)
         if fr:
             from ..monitor.flight_recorder import get_flight_recorder
             get_flight_recorder().record_step(
@@ -894,9 +961,14 @@ class TrainStep:
                 else None,
                 dispatch_ms=None if dispatch_s is None
                 else dispatch_s * 1e3)
-        if self._check_numerics:
+        if watch:
+            def rollback():
+                (self.params, self.buffers, self._acc_grads,
+                 self.opt_state) = prev
+                self._micro_count -= 1
+                self.step_count -= 1
             self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
-                           self.step_count)
+                           self.step_count, rollback=rollback)
         return Tensor(loss)
 
     def __call__(self, *batch):
@@ -925,7 +997,8 @@ class TrainStep:
                 (self.params, self.buffers, self.opt_state, lr, t, key,
                  flat), mon)
             self._jitted[sig] = jitted
-        prev = ((self.params, self.buffers) if self._check_numerics
+        watch = bool(self._check_numerics) or self.skip_nonfinite_budget > 0
+        prev = ((self.params, self.buffers, self.opt_state) if watch
                 else None)
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(mon):
@@ -943,6 +1016,8 @@ class TrainStep:
                     f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
         else:
             self.params, self.buffers, self.opt_state, loss = out
+        if _chaos.active() and _chaos.probe("grad.nonfinite"):
+            loss = jnp.full_like(loss, jnp.nan)
         if fr:
             from ..monitor.flight_recorder import get_flight_recorder
             get_flight_recorder().record_step(
@@ -951,9 +1026,12 @@ class TrainStep:
                 else None,
                 dispatch_ms=None if dispatch_s is None
                 else dispatch_s * 1e3)
-        if self._check_numerics:
+        if watch:
+            def rollback():
+                self.params, self.buffers, self.opt_state = prev
+                self.step_count -= 1
             self._watchdog(loss, prev[0], prev[1], key, flat, treedef,
-                           self.step_count)
+                           self.step_count, rollback=rollback)
         return Tensor(loss)
 
     def sync_to_layer(self):

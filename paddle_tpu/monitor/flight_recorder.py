@@ -22,6 +22,17 @@ Recording is populated by ``TrainStep`` when ``FLAGS_monitor`` or
 ``FLAGS_flight_recorder`` is on (both off = zero recorder writes on the
 hot path, same contract as the metrics registry). Render a dump with
 ``python tools/monitor_report.py --flight flight_recorder_<pid>.json``.
+
+The fault-tolerance stack (docs/FAULT_TOLERANCE.md) records its
+*recovery events* here so a post-mortem reads as one timeline: the
+event names in :data:`RECOVERY_EVENTS` — ``checkpoint_commit`` (a
+checkpoint became durable+visible), ``checkpoint_fallback`` (an
+invalid/torn checkpoint was skipped at resume), ``collective_timeout``
+(the eager-collective watchdog tripped), ``nonfinite_skip`` (an update
+was rolled back under ``skip_nonfinite_budget``), ``preempted``
+(SIGTERM honoured with a final commit), ``chaos`` (an injected fault
+fired) — are rendered as a dedicated "Recovery timeline" section by
+``monitor_report.py --flight``.
 """
 
 from __future__ import annotations
@@ -36,9 +47,16 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
-           "enabled", "trip_dump", "load_dump"]
+           "enabled", "trip_dump", "load_dump", "RECOVERY_EVENTS"]
 
 _EVENT_CAPACITY = 128
+
+# event names that make up a run's recovery timeline (emitters:
+# distributed/checkpoint, distributed/collective, jit/to_static,
+# testing/chaos); monitor_report.py --flight renders these separately
+RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
+                   "collective_timeout", "nonfinite_skip", "preempted",
+                   "trip", "chaos")
 
 
 def _json_safe(v: Any) -> Any:

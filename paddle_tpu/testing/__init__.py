@@ -1,0 +1,10 @@
+"""Test-support utilities shipped with the framework.
+
+``paddle_tpu.testing.chaos`` is the deterministic fault injector the
+fault-tolerance stack (atomic checkpoints, collective timeouts,
+skip-and-continue) is proven against — see docs/FAULT_TOLERANCE.md.
+"""
+
+from . import chaos  # noqa: F401
+
+__all__ = ["chaos"]

@@ -34,6 +34,25 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: drives the paddle_tpu.testing.chaos fault injector "
+        "(injector state is reset around every test by the autouse "
+        "_chaos_isolation fixture)")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """Chaos plans must never leak between tests: the injector is fully
+    disarmed (and any chaos-hung worker threads cancelled) before AND
+    after every test, whether or not the test is marked ``chaos``."""
+    from paddle_tpu.testing import chaos
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

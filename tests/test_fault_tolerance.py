@@ -1,0 +1,725 @@
+"""Fault-tolerant training runtime (docs/FAULT_TOLERANCE.md).
+
+Every recovery path is exercised through the deterministic chaos
+injector (paddle_tpu.testing.chaos) — nothing here depends on timing
+luck:
+
+- atomic checkpoint commit: manifest + rename, verification levels,
+  uncommitted/torn directories skipped with fallback to the newest
+  valid checkpoint (``checkpoint_fallback`` flight events);
+- CheckpointManager: interval saves, SIGTERM preemption with a final
+  commit, ``resume()`` restoring a bit-exact training state incl. the
+  dataloader position, retention GC that never deletes the last valid
+  checkpoint;
+- collective timeouts: a chaos-hung eager collective raises
+  ``CollectiveTimeoutError`` within the flag budget instead of hanging
+  the suite;
+- skip-and-continue: ``skip_nonfinite_budget`` rolls back a NaN step
+  and continues bit-exactly, raising only after N consecutive trips;
+- fs/elastic store retries: exponential backoff with jitter.
+"""
+
+import json
+import os
+import signal
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                               CheckpointError,
+                                               PreemptionSignal,
+                                               latest_step,
+                                               verify_checkpoint)
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.monitor import flight_recorder as flight
+from paddle_tpu.testing import chaos
+
+
+def _build_step(**kwargs):
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    return TrainStep(model, lambda l, a, b: F.cross_entropy(l(a), b),
+                     paddle.optimizer.Adam(learning_rate=1e-2,
+                                           parameters=model.parameters()),
+                     **kwargs)
+
+
+def _batch(i):
+    rng = np.random.default_rng(50 + i)
+    return (rng.standard_normal((8, 8)).astype(np.float32),
+            rng.integers(0, 4, (8,)).astype(np.int64))
+
+
+def _ref_losses(n):
+    step = _build_step()
+    return [float(step(*_batch(i))) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Atomic commit protocol
+# ---------------------------------------------------------------------------
+
+def test_commit_writes_manifest_and_roundtrips(tmp_path):
+    import jax.numpy as jnp
+    path = str(tmp_path / "step_2")
+    state = {"a": jnp.arange(8.0), "n": 5}
+    dckpt.save(state, path, asynchronous=False, step=2)
+    assert not os.path.exists(path + dckpt.STAGING_SUFFIX)
+    assert os.path.exists(os.path.join(path, dckpt.MANIFEST_NAME))
+    assert verify_checkpoint(path, "manifest") is None
+    assert verify_checkpoint(path, "full") is None
+    m = dckpt.read_manifest(path)
+    assert m["step"] == 2
+    assert "['a']" in m["leaves"]
+    assert m["leaves"]["['a']"]["shape"] == [8]
+    # the flags fingerprint answers "what configuration wrote this"
+    assert "checkpoint_verify" in m["flags"]
+    back = dckpt.load(path)
+    np.testing.assert_array_equal(np.asarray(back["a"]), np.arange(8.0))
+
+
+def test_async_save_commits_at_wait(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    path = os.path.join(root, "step_3")
+    dckpt.save({"a": jnp.ones(4)}, path, asynchronous=True, step=3)
+    dckpt.wait()
+    assert verify_checkpoint(path) is None
+    assert latest_step(root) == 3
+
+
+def test_latest_step_skips_uncommitted_and_invalid(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    dckpt.save({"a": jnp.ones(4)}, os.path.join(root, "step_2"),
+               asynchronous=False, step=2)
+    # an interrupted save leaves only a staging dir: never a candidate
+    os.makedirs(os.path.join(root, "step_6.tmp"))
+    # a committed-looking dir without a manifest (legacy/torn): skipped
+    os.makedirs(os.path.join(root, "step_4"))
+    assert latest_step(root) == 2
+    assert verify_checkpoint(os.path.join(root, "step_4")) \
+        == "uncommitted (no manifest)"
+    # FLAGS_checkpoint_verify=off restores legacy manifest-less dirs
+    assert verify_checkpoint(os.path.join(root, "step_4"), "off") is None
+
+
+@pytest.mark.chaos
+def test_torn_write_falls_back_to_previous_valid(tmp_path):
+    """Acceptance: chaos-torn step_4 → latest_step/load_train_step
+    resume from step_2 (never the torn one), visibly as a
+    checkpoint_fallback flight event, and the loss curve continues
+    bit-exactly."""
+    root = str(tmp_path / "ckpts")
+    ref = _ref_losses(4)
+
+    step_a = _build_step()
+    for i in range(2):
+        step_a(*_batch(i))
+    dckpt.save_train_step(step_a, os.path.join(root, "step_2"),
+                          asynchronous=False)
+    for i in range(2, 4):
+        step_a(*_batch(i))
+    chaos.configure("ckpt.write.torn@1")
+    dckpt.save_train_step(step_a, os.path.join(root, "step_4"),
+                          asynchronous=False)
+    chaos.reset()
+    assert chaos.fired() == []  # reset cleared the record too
+
+    reason = verify_checkpoint(os.path.join(root, "step_4"))
+    assert reason is not None and "torn" in reason
+    with flag_scope("flight_recorder", True):
+        assert latest_step(root) == 2
+        events = flight.get_flight_recorder().events
+    fb = [e for e in events if e["event"] == "checkpoint_fallback"]
+    assert fb and fb[0]["step"] == 4 and fb[0]["fallback_to"] == 2
+
+    step_b = _build_step()
+    dckpt.load_train_step(step_b, os.path.join(root, f"step_{latest_step(root)}"))
+    assert step_b.step_count == 2
+    cont = [float(step_b(*_batch(i))) for i in range(2, 4)]
+    assert cont == ref[2:4]
+
+
+@pytest.mark.chaos
+def test_manifest_corruption_invalidates(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    dckpt.save({"a": jnp.ones(4)}, os.path.join(root, "step_2"),
+               asynchronous=False, step=2)
+    chaos.configure("ckpt.manifest.corrupt@1")
+    dckpt.save({"a": jnp.ones(4)}, os.path.join(root, "step_4"),
+               asynchronous=False, step=4)
+    chaos.reset()
+    assert "manifest unreadable" in verify_checkpoint(
+        os.path.join(root, "step_4"))
+    assert latest_step(root) == 2
+    with pytest.raises(CheckpointError, match="refusing to restore"):
+        dckpt.load(os.path.join(root, "step_4"))
+
+
+def test_full_verify_catches_same_size_bit_corruption(tmp_path):
+    import jax.numpy as jnp
+    path = str(tmp_path / "step_2")
+    # CRCs are recorded at commit time only under 'full' (recording
+    # costs a re-read of the staged tree)
+    with flag_scope("checkpoint_verify", "full"):
+        dckpt.save({"a": jnp.arange(64.0)}, path, asynchronous=False,
+                   step=2)
+    m = dckpt.read_manifest(path)
+    assert all("crc32" in e for e in m["files"].values())
+    # flip one byte of the largest data file, size unchanged
+    victim = max(m["files"], key=lambda r: m["files"][r]["size"])
+    vp = os.path.join(path, victim)
+    with open(vp, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert verify_checkpoint(path, "manifest") is None   # size-level blind
+    assert "checksum mismatch" in verify_checkpoint(path, "full")
+    with flag_scope("checkpoint_verify", "full"):
+        assert latest_step(str(tmp_path)) is None
+
+
+def test_wait_and_next_save_propagate_commit_failure(tmp_path, monkeypatch):
+    """A failed background save must never be silent: wait() (and the
+    next save(), which finalizes pending work first) re-raise as
+    CheckpointError, and the checkpointer stays usable afterwards."""
+    import jax.numpy as jnp
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    path = str(tmp_path / "step_1")
+    monkeypatch.setattr(dckpt, "_commit", boom)
+    dckpt.save({"a": jnp.ones(4)}, path, asynchronous=True, step=1)
+    with pytest.raises(CheckpointError, match="commit failed"):
+        dckpt.wait()
+    # failure #2 surfaces at the NEXT save() (which finalizes pending
+    # work first) instead of evaporating
+    dckpt.save({"a": jnp.ones(4)}, path, asynchronous=True, step=1)
+    with pytest.raises(CheckpointError, match="commit failed"):
+        dckpt.save({"a": jnp.ones(4)}, path, asynchronous=True, step=1)
+    monkeypatch.undo()
+    dckpt.save({"a": jnp.ones(4)}, path, asynchronous=True, step=1)
+    dckpt.wait()   # the post-failure save goes through cleanly
+    assert verify_checkpoint(path) is None
+
+
+def test_recommit_to_existing_path_never_leaves_nothing(tmp_path,
+                                                        monkeypatch):
+    """Re-saving onto an existing committed checkpoint parks the old one
+    aside instead of deleting it first: a crash at the worst point (the
+    swap) leaves the old content recoverable on disk, and a successful
+    re-commit leaves exactly one valid dir and no .old."""
+    import jax.numpy as jnp
+    path = str(tmp_path / "step_2")
+    dckpt.save({"a": jnp.zeros(4)}, path, asynchronous=False, step=2)
+    # happy path: replace in place
+    dckpt.save({"a": jnp.ones(4)}, path, asynchronous=False, step=2)
+    assert verify_checkpoint(path) is None
+    np.testing.assert_array_equal(np.asarray(dckpt.load(path)["a"]),
+                                  np.ones(4))
+    assert not os.path.exists(path + dckpt.REPLACED_SUFFIX)
+    # crash at the swap: fail the rename that installs the new dir
+    real_rename = os.rename
+
+    def crashy(src, dst):
+        if dst == path and src.endswith(dckpt.STAGING_SUFFIX):
+            raise OSError("killed at the swap")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(dckpt.os, "rename", crashy)
+    with pytest.raises(CheckpointError):
+        dckpt.save({"a": jnp.full(4, 7.0)}, path, asynchronous=True,
+                   step=2)
+        dckpt.wait()
+    monkeypatch.undo()
+    # the replaced checkpoint survived on disk under .old
+    old = path + dckpt.REPLACED_SUFFIX
+    assert os.path.isdir(old) and verify_checkpoint(old) is None
+    np.testing.assert_array_equal(np.asarray(dckpt.load(old)["a"]),
+                                  np.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: auto-resume driver
+# ---------------------------------------------------------------------------
+
+def test_preemption_resume_is_bit_exact(tmp_path):
+    """Acceptance: SIGTERM mid-run → final commit at the next step
+    boundary → fresh-process resume() → the remaining loss trajectory is
+    BIT-EXACT vs the uninterrupted run (params, opt state, RNG stream
+    and dataloader offset all restored)."""
+    root = str(tmp_path / "ckpts")
+    ref = _ref_losses(6)
+
+    step_a = _build_step()
+    losses_a = []
+    with pytest.raises(PreemptionSignal) as exc:
+        with CheckpointManager(step_a, root, interval_steps=2,
+                               keep_n=2) as mgr:
+            for i in range(6):
+                losses_a.append(float(step_a(*_batch(i))))
+                if i == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                mgr.on_step(dataloader_state={"offset": i + 1})
+    assert exc.value.step == 4
+    assert losses_a == ref[:4]
+
+    step_b = _build_step()
+    with CheckpointManager(step_b, root, interval_steps=2,
+                           keep_n=2) as mgr:
+        info = mgr.resume()
+        assert info["step"] == 4
+        assert info["dataloader"] == {"offset": 4}
+        losses_b = [float(step_b(*_batch(i)))
+                    for i in range(info["dataloader"]["offset"], 6)]
+    assert losses_b == ref[4:]
+
+
+def test_preemption_commits_despite_prior_failed_async_save(tmp_path,
+                                                            monkeypatch):
+    """A failed interval save must not abort the SIGTERM final commit:
+    the grace period's one job is committing the current state."""
+    root = str(tmp_path / "ckpts")
+    real_commit = dckpt._commit
+
+    def flaky_commit(tmp, final, *a, **k):
+        if final.endswith("step_2"):
+            raise OSError("transient store failure")
+        return real_commit(tmp, final, *a, **k)
+
+    monkeypatch.setattr(dckpt, "_commit", flaky_commit)
+    step = _build_step()
+    with pytest.raises(PreemptionSignal) as exc:
+        with CheckpointManager(step, root, interval_steps=2,
+                               keep_n=2) as mgr:
+            for i in range(3):
+                step(*_batch(i))
+                if i == 2:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                mgr.on_step()   # i=1 enqueues step_2 (commit will fail)
+    assert exc.value.step == 3
+    assert latest_step(root) == 3     # final commit landed regardless
+
+
+def test_manager_interval_saves_and_gc(tmp_path):
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    with CheckpointManager(step, root, interval_steps=2, keep_n=2,
+                           asynchronous=False) as mgr:
+        for i in range(8):
+            step(*_batch(i))
+            mgr.on_step()
+    steps = dckpt.checkpoint_steps(root)
+    # keep_n=2 newest valid survive; older interval saves GC'd
+    assert steps == [6, 8]
+    assert all(verify_checkpoint(os.path.join(root, f"step_{n}")) is None
+               for n in steps)
+    assert mgr.save_count == 4
+
+
+def test_async_interval_save_commits_at_next_step_boundary(tmp_path):
+    """An async interval save must become visible at the first step
+    boundary after serialization finishes — not at the NEXT interval
+    (which would double the worst-case SIGKILL loss)."""
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    with CheckpointManager(step, root, interval_steps=4, keep_n=2) as mgr:
+        for i in range(4):
+            step(*_batch(i))
+            mgr.on_step()        # step 4 enqueues the async save
+        # serialization of this tiny tree finishes almost immediately;
+        # give it a bounded moment, then one more step boundary
+        deadline = time.monotonic() + 30.0
+        while (not dckpt.Checkpointer.instance().pending_ready()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        step(*_batch(4))
+        mgr.on_step()            # step 5: NOT an interval — commits here
+        assert latest_step(root) == 4
+        assert verify_checkpoint(os.path.join(root, "step_4")) is None
+
+
+@pytest.mark.chaos
+def test_resume_fallback_event_names_landing_step(tmp_path):
+    """resume()'s checkpoint_fallback events carry the step actually
+    resumed from (same semantics as latest_step)."""
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    mgr = CheckpointManager(step, root, interval_steps=1, keep_n=3,
+                            asynchronous=False)
+    try:
+        step(*_batch(0))
+        mgr.save()
+        step(*_batch(1))
+        chaos.configure("ckpt.write.torn@1")
+        mgr.save()
+        chaos.reset()
+    finally:
+        mgr.close()
+    fresh = _build_step()
+    mgr2 = CheckpointManager(fresh, root, interval_steps=1)
+    try:
+        with flag_scope("flight_recorder", True):
+            info = mgr2.resume()
+            events = flight.get_flight_recorder().events
+    finally:
+        mgr2.close()
+    assert info["step"] == 1
+    fb = [e for e in events if e["event"] == "checkpoint_fallback"]
+    assert fb and fb[0]["step"] == 2 and fb[0]["fallback_to"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_hang_without_timeout_budget_is_rejected():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1])
+    chaos.arm("collective.hang")
+    with pytest.raises(RuntimeError, match="FLAGS_collective_timeout_s"):
+        C.all_reduce(jnp.ones((2, 4), jnp.float32), group=g)
+    chaos.reset()
+
+
+def test_gc_never_deletes_last_valid(tmp_path):
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    step(*_batch(0))
+    mgr = CheckpointManager(step, root, interval_steps=1, keep_n=1,
+                            asynchronous=False)
+    try:
+        mgr.save()
+        mgr.gc()
+        assert dckpt.checkpoint_steps(root) == [1]
+        # orphan staging dirs are GC'd
+        os.makedirs(os.path.join(root, "step_9.tmp"))
+        mgr.gc()
+        assert not os.path.exists(os.path.join(root, "step_9.tmp"))
+        assert dckpt.checkpoint_steps(root) == [1]
+    finally:
+        mgr.close()
+
+
+@pytest.mark.chaos
+def test_resume_falls_back_past_unrestorable_checkpoint(tmp_path):
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    mgr = CheckpointManager(step, root, interval_steps=1, keep_n=3,
+                            asynchronous=False)
+    try:
+        step(*_batch(0))
+        mgr.save()
+        step(*_batch(1))
+        chaos.configure("ckpt.write.torn@1")
+        mgr.save()
+        chaos.reset()
+        fresh = _build_step()
+        mgr2 = CheckpointManager(fresh, root, interval_steps=1)
+        try:
+            info = mgr2.resume()
+        finally:
+            mgr2.close()
+        assert info["step"] == 1     # torn step_2 skipped
+    finally:
+        mgr.close()
+
+
+@pytest.mark.chaos
+def test_worker_die_site_raises_chaos_fault(tmp_path):
+    step = _build_step()
+    mgr = CheckpointManager(step, str(tmp_path), interval_steps=100)
+    try:
+        chaos.configure("worker.die@2")
+        step(*_batch(0))
+        mgr.on_step()                 # occurrence 1: survives
+        step(*_batch(1))
+        with pytest.raises(chaos.ChaosFault) as exc:
+            mgr.on_step()             # occurrence 2: dies
+        assert exc.value.site == "worker.die"
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Collective timeout watchdog
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_hung_collective_raises_within_budget():
+    """Acceptance: a chaos-hung eager collective raises
+    CollectiveTimeoutError within FLAGS_collective_timeout_s (plus
+    watchdog overhead) instead of hanging the suite."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+
+    g = C.new_group([0, 1])
+    x = jnp.ones((2, 4), jnp.float32)
+    with flag_scope("collective_timeout_s", 1.0):
+        # watchdog pass-through: a healthy collective still works
+        out = C.all_reduce(x, group=g)
+        np.testing.assert_allclose(np.asarray(out)[0], 2.0)
+        chaos.arm("collective.hang", at=1)
+        with flag_scope("flight_recorder", True):
+            t0 = time.monotonic()
+            with pytest.raises(C.CollectiveTimeoutError) as exc:
+                C.all_reduce(jnp.ones((2, 4), jnp.float32), group=g)
+            elapsed = time.monotonic() - t0
+            events = flight.get_flight_recorder().events
+    assert 0.9 <= elapsed < 5.0, elapsed
+    assert exc.value.op == "all_reduce"
+    assert exc.value.timeout_s == 1.0
+    names = [e["event"] for e in events]
+    assert "collective_timeout" in names
+    assert "chaos" in names           # the injected fault is on record
+    chaos.reset()
+    # the abandoned worker must not poison later dispatches
+    out = C.all_reduce(jnp.ones((2, 4), jnp.float32), group=g)
+    np.testing.assert_allclose(np.asarray(out)[0], 2.0)
+
+
+def test_collective_timeout_off_by_default():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1])
+    out = C.all_reduce(jnp.ones((2, 4), jnp.float32), group=g)
+    np.testing.assert_allclose(np.asarray(out)[0], 2.0)
+
+
+# ---------------------------------------------------------------------------
+# skip_nonfinite_budget: graceful degradation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_nonfinite_step_skipped_and_rolled_back():
+    # constant batch: the rolled-back update is retried on the SAME data
+    # next call, so the post-skip trajectory must realign with the
+    # uninterrupted one exactly
+    ref_step = _build_step()
+    ref = [float(ref_step(*_batch(0))) for _ in range(4)]
+    chaos.configure("grad.nonfinite@2")
+    step = _build_step(skip_nonfinite_budget=2)
+    losses = []
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with flag_scope("flight_recorder", True):
+            for i in range(5):
+                losses.append(float(step(*_batch(0))))
+            events = flight.get_flight_recorder().events
+    chaos.reset()
+    assert np.isnan(losses[1])
+    # the update was rolled back: the retried step reproduces the
+    # uninterrupted trajectory bit-exactly
+    assert losses[2] == ref[1] and losses[4] == ref[3]
+    assert step.step_count == 4
+    assert step.stats()["nonfinite_skips"] == 1
+    skip_events = [e for e in events if e["event"] == "nonfinite_skip"]
+    assert skip_events and skip_events[0]["budget"] == 2
+    assert any("skipped and rolled back" in str(w.message) for w in caught)
+
+
+@pytest.mark.chaos
+def test_nonfinite_budget_exhaustion_raises():
+    from paddle_tpu.monitor.numerics import NonFiniteError
+    chaos.configure("grad.nonfinite")      # every step trips
+    step = _build_step(skip_nonfinite_budget=2)
+    done = 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(NonFiniteError, match="budget exhausted"):
+            for i in range(5):
+                step(*_batch(0))
+                done += 1
+    chaos.reset()
+    assert done == 2                       # two skips, third trip raises
+    assert step.stats()["nonfinite_skips"] == 2
+    # exhaustion also rolls back: the state a supervisor checkpoints
+    # after catching the error is the last-known-good one
+    assert step.step_count == 0
+    assert all(bool(np.isfinite(np.asarray(v)).all())
+               for v in step.params.values())
+
+
+@pytest.mark.chaos
+def test_finite_step_resets_consecutive_counter():
+    """budget=1: trip, finite, trip — the middle finite step resets the
+    consecutive counter, so the second trip is a SKIP, not a raise."""
+    ref = _ref_losses(1)
+    step = _build_step(skip_nonfinite_budget=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        chaos.arm("grad.nonfinite")
+        l0 = float(step(*_batch(0)))       # trip: skipped (1/1)
+        chaos.reset()
+        l1 = float(step(*_batch(0)))       # finite: counter resets
+        chaos.arm("grad.nonfinite")
+        l2 = float(step(*_batch(0)))       # trip again: skipped, no raise
+        chaos.reset()
+    assert np.isnan(l0) and l1 == ref[0] and np.isnan(l2)
+    assert step.stats()["nonfinite_skips"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fs/elastic store retries
+# ---------------------------------------------------------------------------
+
+def test_retry_with_backoff_exponential_jittered():
+    from paddle_tpu.distributed.fleet.utils.fs import retry_with_backoff
+    sleeps = []
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 4:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_with_backoff(flaky, retries=5, base_delay=0.1,
+                             retry_on=(OSError,), sleep=sleeps.append)
+    assert out == "ok" and attempts["n"] == 4
+    assert len(sleeps) == 3
+    # exponential base with jitter in [1, 1.5): delay_k in base*2^k*[1,1.5)
+    for k, d in enumerate(sleeps):
+        lo = 0.1 * (2 ** k)
+        assert lo <= d < lo * 1.5, (k, d)
+
+
+def test_retry_with_backoff_respects_permanent_failures():
+    from paddle_tpu.distributed.fleet.utils.fs import retry_with_backoff
+    calls = {"n": 0}
+
+    def permanent():
+        calls["n"] += 1
+        e = OSError("no such CLI")
+        e.retryable = False
+        raise e
+
+    with pytest.raises(OSError):
+        retry_with_backoff(permanent, retries=5, retry_on=(OSError,),
+                           sleep=lambda s: pytest.fail("slept on a "
+                                                       "permanent error"))
+    assert calls["n"] == 1
+
+
+def test_retry_exhaustion_reraises():
+    from paddle_tpu.distributed.fleet.utils.fs import retry_with_backoff
+    with pytest.raises(OSError, match="still down"):
+        retry_with_backoff(lambda: (_ for _ in ()).throw(
+            OSError("still down")), retries=2, retry_on=(OSError,),
+            sleep=lambda s: None)
+
+
+def test_hdfs_missing_cli_fails_fast_no_retry():
+    from paddle_tpu.distributed.fleet.utils.fs import ExecuteError, HDFSClient
+    client = HDFSClient(hadoop_home="/nonexistent")
+    t0 = time.monotonic()
+    with pytest.raises(ExecuteError, match="not found"):
+        client.upload("/tmp/x", "/remote/x")
+    assert time.monotonic() - t0 < 1.0     # no backoff on permanent fail
+
+
+def test_elastic_heartbeat_uses_store_retry(tmp_path, monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.fleet.utils import fs as fs_mod
+
+    seen = {}
+    real = fs_mod.retry_with_backoff
+
+    def spy(fn, **kw):
+        seen.update(kw)
+        return real(fn, **kw)
+
+    monkeypatch.setattr(fs_mod, "retry_with_backoff", spy)
+    mgr = ElasticManager(root=str(tmp_path), rank=0, np_=1, min_np=1,
+                         max_np=1, timeout=60)
+    mgr.beat()
+    assert seen["retry_on"] == (OSError,)
+    assert mgr.alive_workers() == [0]
+    mgr.mark_completed()
+    assert os.path.exists(os.path.join(mgr.root, "COMPLETED"))
+
+
+# ---------------------------------------------------------------------------
+# Chaos injector semantics + recovery-timeline rendering
+# ---------------------------------------------------------------------------
+
+def test_chaos_spec_parsing_and_determinism():
+    chaos.configure("grad.nonfinite@2, collective.hang:0.5*3", seed=7)
+    assert not chaos.probe("grad.nonfinite")      # occurrence 1
+    assert chaos.probe("grad.nonfinite")          # occurrence 2 fires
+    assert not chaos.probe("grad.nonfinite")      # @N is single-shot
+    pattern1 = [chaos.probe("collective.hang") for _ in range(20)]
+    assert sum(pattern1) == 3                     # *3 cap
+    chaos.configure("collective.hang:0.5", seed=7)
+    pattern2 = [chaos.probe("collective.hang") for _ in range(20)]
+    # same (seed, site, occurrence) → same decisions (until the cap bit)
+    assert pattern1[:pattern1.index(True) + 1] == \
+        pattern2[:pattern1.index(True) + 1]
+    chaos.reset()
+    assert not chaos.active()
+    assert not chaos.probe("collective.hang")
+
+
+def test_chaos_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown site"):
+        chaos.arm("ckpt.write.tron")
+    assert not chaos.active()
+
+
+def test_flight_report_renders_recovery_timeline(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import monitor_report
+
+    fr = flight.FlightRecorder(capacity=16, dump_dir=str(tmp_path))
+    fr.record_event("checkpoint_commit", path="/ck/step_2", step=2,
+                    files=9, bytes=1234)
+    fr.record_event("compile", kind="step", step=1)   # not recovery
+    fr.record_event("collective_timeout", op="all_reduce", group="dp",
+                    nranks=4, timeout_s=5.0)
+    fr.record_event("nonfinite_skip", step=7, offender="loss",
+                    consecutive=1, budget=3)
+    fr.record_event("checkpoint_fallback", step=8, reason="torn file",
+                    fallback_to=2)
+    path = fr.dump(reason="explicit")
+    out = monitor_report.render_flight(flight.load_dump(path), last=10)
+    assert "Recovery timeline (4 events)" in out
+    assert "checkpoint_commit" in out and "checkpoint_fallback" in out
+    assert "collective_timeout" in out and "nonfinite_skip" in out
+    assert "op=all_reduce" in out
+    # non-recovery events stay out of the timeline section
+    timeline = out.split("== Events")[0]
+    assert "compile" not in timeline
+
+
+def test_manager_sidecar_is_committed_and_covered(tmp_path):
+    """The dataloader-position sidecar is inside the manifest's file
+    set: a torn sidecar invalidates the checkpoint like any data file."""
+    root = str(tmp_path / "ckpts")
+    step = _build_step()
+    step(*_batch(0))
+    mgr = CheckpointManager(step, root, interval_steps=1,
+                            asynchronous=False)
+    try:
+        path = mgr.save(dataloader_state={"epoch": 1, "offset": 17})
+    finally:
+        mgr.close()
+    m = dckpt.read_manifest(path)
+    assert "manager_state.json" in m["files"]
+    with open(os.path.join(path, "manager_state.json")) as f:
+        sidecar = json.load(f)
+    assert sidecar["dataloader"] == {"epoch": 1, "offset": 17}
+    # truncating the sidecar breaks verification
+    with open(os.path.join(path, "manager_state.json"), "w") as f:
+        f.write("{")
+    assert "torn file" in verify_checkpoint(path)

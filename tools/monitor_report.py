@@ -17,8 +17,10 @@ stream — prints:
 
 ``--flight`` switches input format entirely: the argument is a crash
 flight-recorder dump (monitor/flight_recorder.py JSON) and the report
-shows trip reason, environment fingerprint, the event log and the
-last-N step records.
+shows trip reason, environment fingerprint, a *recovery timeline*
+(checkpoint commits/fallbacks, collective timeouts, non-finite skips,
+preemptions, chaos fires — docs/FAULT_TOLERANCE.md), the event log and
+the last-N step records.
 
 Usage:
     python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory]
@@ -107,9 +109,37 @@ def _memory_section(latest, used) -> List[str]:
     return out
 
 
+# recovery-timeline event names (kept in sync with
+# paddle_tpu.monitor.flight_recorder.RECOVERY_EVENTS; inlined so the
+# report renders dumps without importing the framework)
+_RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
+                    "collective_timeout", "nonfinite_skip", "preempted",
+                    "trip", "chaos")
+
+
+def _recovery_section(events: List[dict]) -> List[str]:
+    """Chronological fault/recovery timeline: what failed, what the
+    runtime did about it, relative to the first recovery event."""
+    recov = [r for r in events if r.get("event") in _RECOVERY_EVENTS]
+    if not recov:
+        return []
+    t0 = next((r["ts"] for r in recov
+               if isinstance(r.get("ts"), (int, float))), None)
+    rows = []
+    for r in recov:
+        ts = r.get("ts")
+        rel = (f"+{ts - t0:.2f}s" if isinstance(ts, (int, float))
+               and t0 is not None else "-")
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
+                           if k not in ("event", "ts"))
+        rows.append([rel, str(r.get("event")), detail])
+    return _table(f"Recovery timeline ({len(recov)} events)",
+                  ["t", "event", "detail"], rows)
+
+
 def render_flight(doc: dict, last: int = 10) -> str:
-    """Render a flight-recorder dump: trip reason, fingerprint, events,
-    last-N step records."""
+    """Render a flight-recorder dump: trip reason, fingerprint, the
+    fault/recovery timeline, events, last-N step records."""
     lines = ["== Flight recorder dump =="]
     reason = doc.get("reason", "?")
     trip = doc.get("trip_step")
@@ -122,6 +152,7 @@ def render_flight(doc: dict, last: int = 10) -> str:
         f"{k}={fp[k]}" for k in sorted(fp) if k != "argv") or "(none)"))
     lines.append("")
     ev = doc.get("events") or []
+    lines += _recovery_section(ev)
     e_rows = [[str(r.get("event", "?")),
                str(r.get("kind", r.get("op", "-"))),
                str(r.get("step", "-")),
