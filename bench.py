@@ -550,6 +550,127 @@ def bench_ernie():
         return None
 
 
+def bench_serve(quick: bool = False) -> list:
+    """``--serve``: GPT-2 345M decode under the synthetic open-loop load
+    generator (paddle_tpu.serving, docs/SERVING.md) — the BENCH_serve
+    record: serving tokens/s plus p50/p99 per-dispatch decode latency
+    and p50 TTFT, gated by tools/check_bench.py like every other metric
+    line (ms = lower-is-better, tokens/s = higher-is-better).
+
+    ``--quick`` swaps in gpt_tiny (CPU smoke: same code path, metric
+    names carry the model so tiny numbers never gate 345M records)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTForPretraining, gpt2_medium,
+                                       gpt_tiny)
+    from paddle_tpu.serving import (LoadSpec, SamplingParams,
+                                    ServingConfig, ServingEngine,
+                                    run_open_loop)
+
+    paddle.seed(42)
+    if quick:
+        name, cfg = "gpt_tiny", gpt_tiny()
+        serve_cfg = ServingConfig(max_batch_slots=4, block_size=8,
+                                  max_context_len=128,
+                                  prefill_buckets=(16, 32),
+                                  batch_buckets=(1, 2, 4))
+        spec = LoadSpec(num_requests=6, rate_rps=8.0,
+                        prompt_len_range=(8, 24), max_new_range=(4, 12),
+                        vocab_size=cfg.vocab_size, seed=0,
+                        sampling=SamplingParams())
+    else:
+        name, cfg = "gpt2_345m", gpt2_medium()
+        serve_cfg = ServingConfig(max_batch_slots=8, block_size=16,
+                                  max_context_len=512,
+                                  prefill_buckets=(128, 256),
+                                  batch_buckets=(1, 2, 4))
+        spec = LoadSpec(num_requests=16, rate_rps=2.0,
+                        prompt_len_range=(64, 224),
+                        max_new_range=(16, 48),
+                        vocab_size=cfg.vocab_size, seed=0,
+                        sampling=SamplingParams())
+    model = GPTForPretraining(cfg)
+    engine = ServingEngine(model, serve_cfg)
+    t0 = time.perf_counter()
+    # warm the serving signatures the load mix will hit BEFORE traffic:
+    # production keeps executables resident; cold compiles would land in
+    # the first requests' TTFT and gate-noise every record
+    n_prog = engine.warmup()
+    log(f"serve[{name}]: {n_prog} serving programs warm in "
+        f"{time.perf_counter() - t0:.1f}s "
+        f"(buckets {serve_cfg.prefill_buckets} x "
+        f"{serve_cfg.batch_buckets} + decode)")
+    summary = run_open_loop(engine, spec)
+    log(f"serve[{name}]: {summary['requests_completed']} requests, "
+        f"{summary['tokens_generated']} tokens, "
+        f"{summary['tokens_per_sec']:.1f} tok/s, "
+        f"decode p50 {summary['decode_step_p50_s']*1e3:.1f} ms / "
+        f"p99 {summary['decode_step_p99_s']*1e3:.1f} ms, "
+        f"ttft p50 {summary['ttft_p50_s']*1e3:.1f} ms, "
+        f"mean occupancy {summary['mean_decode_occupancy']:.2f}, "
+        f"preemptions {summary['preemptions']}")
+    return [
+        metric_line(f"serve_{name}_tokens_per_sec",
+                    summary["tokens_per_sec"], "tokens/s",
+                    vs_baseline=1.0,
+                    occupancy=summary["mean_decode_occupancy"]),
+        metric_line(f"serve_{name}_decode_p50_ms",
+                    summary["decode_step_p50_s"] * 1e3, "ms",
+                    vs_baseline=1.0),
+        metric_line(f"serve_{name}_decode_p99_ms",
+                    summary["decode_step_p99_s"] * 1e3, "ms",
+                    vs_baseline=1.0),
+        metric_line(f"serve_{name}_ttft_p50_ms",
+                    summary["ttft_p50_s"] * 1e3, "ms", vs_baseline=1.0),
+    ]
+
+
+def run_serve_mode(quick: bool) -> None:
+    """--serve: emit ONLY the serving metric lines (one JSON per line),
+    write/self-gate the BENCH_serve.json record (full runs), and dump
+    the monitor registry (per-request latency histograms, queue gauges —
+    tools/monitor_report.py --serve renders it)."""
+    import os
+    metrics = bench_serve(quick=quick)
+    for m in metrics:
+        print(json.dumps(m), flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from paddle_tpu.monitor import get_registry
+        mpath = os.path.join(here, "BENCH_monitor.jsonl")
+        get_registry().dump_jsonl(mpath, extra={"source": "bench_serve"})
+        log(f"monitor: registry dumped to {mpath} "
+            "(render: python tools/monitor_report.py --serve)")
+    except Exception as e:
+        log(f"monitor dump skipped: {e!r}")
+    if quick:
+        log("serve: --quick run, BENCH_serve.json not written")
+        return
+    rec = os.path.join(here, "BENCH_serve.json")
+    try:
+        sys.path.insert(0, os.path.join(here, "tools"))
+        import check_bench
+        if os.path.exists(rec):
+            with open(rec) as f:
+                old = check_bench._metric_list(json.load(f))
+            for p in check_bench.compare_common(old, metrics):
+                log("BENCH_serve GATE: " + p)
+    except Exception as e:
+        log(f"serve gate skipped: {e!r}")
+    # the previous record survives as .prev EVEN when the gate above
+    # failed (corrupt record, import error): a regressed or broken run
+    # must never silently become the next baseline
+    try:
+        if os.path.exists(rec):
+            os.replace(rec, rec + ".prev")
+    except OSError as e:
+        log(f"could not park previous record: {e!r}")
+    with open(rec, "w") as f:
+        json.dump(metrics, f, indent=1)
+    log(f"serve: record written to {rec} "
+        "(gate: python tools/check_bench.py BENCH_serve.json.prev "
+        "BENCH_serve.json)")
+
+
 def main() -> None:
     import jax
     # rbg keys: dropout mask generation is ~10x cheaper than threefry on
@@ -584,6 +705,11 @@ def main() -> None:
         paddle.set_flags({"flight_recorder": True})
         log(f"chaos armed: {spec} (seed={seed}; flight recorder on)")
     full = "--quick" not in sys.argv
+    if "--serve" in sys.argv:
+        # serving bench is its own record (BENCH_serve): the training
+        # metric lines and the last-line-headline contract stay untouched
+        run_serve_mode(quick=not full)
+        return
     metrics = []
 
     def add(result):

@@ -127,6 +127,16 @@ define_flag("scan_layers", True,
             "(docs/PARITY.md internal-layout contract). Models opt in via "
             "their config (GPTConfig/BertConfig/ErnieConfig.scan_layers); "
             "this flag is the global kill switch.")
+define_flag("scan_decode", True,
+            "Run paged-KV-cache decode/prefill through the SAME "
+            "scan-over-layers program layout as training (nn.scan."
+            "scan_layers_with_cache): per-layer KV pages ride the scan as "
+            "scanned-over state, so the decode program's trace+compile "
+            "cost stays O(1) in depth. Off = the per-layer Python loop "
+            "layout (same math, O(num_layers) trace; the kill switch if "
+            "a backend mishandles scanned cache state). Legacy "
+            "list-of-StaticCache decoding always uses the loop and "
+            "records a scan_fallback_total counter.")
 define_flag("chunked_ce_threshold", 4096,
             "Vocab size at or above which softmax cross-entropy streams "
             "over vocab chunks (nn.chunked_ce): online logsumexp with f32 "

@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+__all__ = ["Config", "Predictor", "create_predictor",
+           "create_serving_engine", "PrecisionType"]
 
 
 class PrecisionType:
@@ -181,12 +182,28 @@ class Predictor:
         specs = [s if isinstance(s, InputSpec) else InputSpec(s)
                  for s in config.input_spec]
 
+        bf16 = config._precision == PrecisionType.Bfloat16
+
         def pure(p, b, *inputs):
+            if bf16:
+                # the activation stream must match the cast weights (conv
+                # ops require one dtype); outputs come back f32 — the
+                # standard bf16-compute/f32-results serving contract
+                inputs = [i.astype(jnp.bfloat16)
+                          if hasattr(i, "dtype") and
+                          jnp.issubdtype(i.dtype, jnp.floating) else i
+                          for i in inputs]
             with bind(layer, p, dict(b)), no_grad(), \
                     trace_rng(jax.random.key(0)):
                 out = layer(*[Tensor(i) for i in inputs])
             from ..jit.functional import unwrap
-            return unwrap(out)
+            out = unwrap(out)
+            if bf16:
+                out = jax.tree_util.tree_map(
+                    lambda o: o.astype(jnp.float32)
+                    if hasattr(o, "dtype") and
+                    jnp.issubdtype(o.dtype, jnp.floating) else o, out)
+            return out
 
         jitted = jax.jit(pure)
         self._runner = lambda *raw: jitted(params, buffers, *raw)
@@ -251,3 +268,45 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(config_or_layer, serving_config=None):
+    """LLM serving entry point: the generation analogue of
+    :func:`create_predictor` (reference surface: the inference API over
+    AnalysisPredictor — here the continuous-batching engine of
+    :mod:`paddle_tpu.serving`, docs/SERVING.md).
+
+    Accepts a live decoder-only Layer (GPT-style ``forward(input_ids,
+    caches=..., cache_pos=...)``), or a ``Config.from_layer`` carrying
+    weight passes: ``enable_int8()`` applies weight-only quantization to
+    the layer, ``enable_tpu_bf16()`` casts the engine's parameter
+    snapshot to bf16 (the memory-bound-decode win) before any serving
+    program compiles.
+    """
+    from ..serving import ServingConfig, ServingEngine
+
+    precision = PrecisionType.Float32
+    if isinstance(config_or_layer, Config):
+        cfg = config_or_layer
+        layer = cfg.layer
+        if layer is None:
+            raise ValueError(
+                "create_serving_engine needs a live layer "
+                "(Config.from_layer): decode programs are specialized "
+                "to the serving bucket table at engine build, not at "
+                "jit.save time")
+        if cfg._weight_quant:
+            from ..slim import quantize_weights
+            quantize_weights(layer)
+        precision = cfg._precision
+    else:
+        layer = config_or_layer
+    engine = ServingEngine(layer, serving_config or ServingConfig())
+    if precision == PrecisionType.Bfloat16:
+        # cast the engine's own snapshot (the layer is untouched, same
+        # contract as Predictor._init_from_layer); programs compile
+        # lazily, so every serving signature sees the bf16 params
+        engine.params = {k: v.astype(jnp.bfloat16)
+                         if jnp.issubdtype(v.dtype, jnp.floating) else v
+                         for k, v in engine.params.items()}
+    return engine

@@ -1,0 +1,113 @@
+"""AOT-compiled program signatures: ``jit.lower().compile()`` with a
+self-healing re-lower on input-sharding drift.
+
+Shared by the two places that build long-lived executables ahead of
+dispatch and need the ``Lowered``/``Compiled`` stages in hand:
+
+- :class:`~paddle_tpu.jit.to_static.TrainStep` — per-program-kind
+  cost/memory attribution (``lowered.cost_analysis()`` /
+  ``compiled.memory_analysis()``, PR 4);
+- the serving engine (:mod:`paddle_tpu.serving.engine`) — prefill/decode
+  programs compiled per bucketed signature at warmup, so the first
+  request never pays a trace+compile and the bucket table bounds the
+  executable count.
+
+Why not plain ``jax.jit``: dispatch-mode jit hides both stages and
+compiles lazily at first call; an AOT ``Compiled`` exposes them but
+REFUSES input layouts/shardings that drift from the example arguments
+(e.g. ZeRO: XLA re-shards updated params over the zero axis, so step 2's
+inputs no longer match step 1's executable — dispatch-mode jit silently
+recompiles there). :class:`AOTProgram` does the same healing explicitly:
+re-lower/re-compile on the mismatch ValueError, and after repeated
+flip-flops hand the entry to dispatch-mode jit, whose executable cache
+holds every layout at once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+__all__ = ["AOTProgram"]
+
+
+class AOTProgram:
+    """One program signature, compiled ahead of time.
+
+    ``on_attribute(kind, lowered, compiled)`` is called after every
+    successful build (including heals — newest wins), with the exact
+    lowering and executable the calls will run; attribution therefore
+    costs no extra trace or compile.  When the AOT stage is unavailable
+    (exotic backend/version), calls fall back to dispatch-mode jit and
+    ``aot_available`` is False — the program still runs, attribution is
+    skipped.
+    """
+
+    #: layout flip-flops tolerated under one shape signature before the
+    #: entry is handed to dispatch-mode jit for good
+    MAX_HEALS = 2
+
+    def __init__(self, kind: str, fn: Callable,
+                 donate_argnums: Sequence[int] = (),
+                 on_attribute: Optional[Callable[[str, Any, Any], None]]
+                 = None):
+        self.kind = kind
+        self._jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+        self._on_attribute = on_attribute
+        self._compiled: Any = None
+        self.heals = 0
+        self.builds = 0
+
+    # -- construction ------------------------------------------------------
+    def _build(self, args) -> Any:
+        """lower+compile for ``args``; None when the AOT stage is
+        unavailable (the dispatch path still runs the program)."""
+        from .to_static import _control_flow_guidance
+        with _control_flow_guidance():
+            lowered = self._jitted.lower(*args)
+        try:
+            compiled = lowered.compile()
+        except Exception:
+            return None
+        self.builds += 1
+        if self._on_attribute is not None:
+            self._on_attribute(self.kind, lowered, compiled)
+        return compiled
+
+    def compile(self, example_args) -> "AOTProgram":
+        """Build the executable for the example signature (idempotent on
+        success; a failed AOT stage leaves the dispatch fallback)."""
+        self._compiled = self._build(example_args)
+        return self
+
+    @property
+    def aot_available(self) -> bool:
+        return self._compiled is not None
+
+    # -- dispatch ----------------------------------------------------------
+    def __call__(self, *args):
+        if self._compiled is None:
+            return self._jitted(*args)
+        try:
+            return self._compiled(*args)
+        except ValueError as e:
+            if "Compiled object called with" not in str(e):
+                raise
+            # Input shardings/layouts moved since this signature was
+            # compiled — the drift dispatch-mode jit silently recompiles
+            # through. Heal the same way, re-attributing from the new
+            # executable. The mismatch is detected BEFORE execution, so
+            # donated args are intact.
+            self.heals += 1
+            if self.heals > self.MAX_HEALS:
+                # layouts keep flip-flopping under one shape signature:
+                # hand the entry to dispatch-mode jit, whose executable
+                # cache holds every layout at once
+                self._compiled = None
+                return self._jitted(*args)
+            fresh = self._build(args)
+            self._compiled = fresh
+            if fresh is None:
+                return self._jitted(*args)
+            return fresh(*args)

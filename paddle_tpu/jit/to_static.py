@@ -585,56 +585,16 @@ class TrainStep:
         executable the step will run — one trace, one backend compile,
         exactly like the dispatch path, but with the ``Lowered`` and
         ``Compiled`` stages in hand for ``cost_analysis()`` /
-        ``memory_analysis()`` (the dispatch path hides both)."""
-        jitted = jax.jit(fn, donate_argnums=donate_argnums)
-
-        def build(args):
-            with _control_flow_guidance():
-                lowered = jitted.lower(*args)
-            try:
-                compiled = lowered.compile()
-            except Exception:
-                # AOT stage unavailable (exotic backend/version): the
-                # dispatch path still runs the step; attribution skipped.
-                return None
-            self._attribute_program(kind, lowered, compiled, mon)
-            return compiled
-
-        compiled = build(example_args)
-        if compiled is None:
-            return jitted
-        state = {"compiled": compiled, "heals": 0}
-
-        def call(*args):
-            if state["compiled"] is None:
-                return jitted(*args)
-            try:
-                return state["compiled"](*args)
-            except ValueError as e:
-                if "Compiled object called with" not in str(e):
-                    raise
-                # Input shardings/layouts moved since this signature was
-                # compiled — e.g. ZeRO: XLA shards the updated params
-                # over the zero axis on output, so step 2's inputs no
-                # longer match step 1's executable. The dispatch path
-                # silently recompiles here; do the same, re-attributing
-                # from the new executable (newest wins). The mismatch is
-                # detected BEFORE execution, so donated args are intact.
-                state["heals"] += 1
-                if state["heals"] > 2:
-                    # layouts keep flip-flopping under one shape
-                    # signature: hand the entry to dispatch-mode jit,
-                    # whose executable cache holds every layout at once
-                    state["compiled"] = None
-                    return jitted(*args)
-                fresh = build(args)
-                if fresh is None:
-                    state["compiled"] = None
-                    return jitted(*args)
-                state["compiled"] = fresh
-                return fresh(*args)
-
-        return call
+        ``memory_analysis()`` (the dispatch path hides both). The
+        lower/compile + sharding-drift self-heal machinery lives in
+        :class:`paddle_tpu.jit.aot.AOTProgram` (shared with the serving
+        engine's bucketed signatures)."""
+        from .aot import AOTProgram
+        return AOTProgram(
+            kind, fn, donate_argnums=donate_argnums,
+            on_attribute=lambda k, lowered, compiled:
+                self._attribute_program(k, lowered, compiled, mon),
+        ).compile(example_args)
 
     def _attribute_program(self, kind: str, lowered, compiled, mon: bool):
         """Capture per-program FLOPs/bytes and the static HBM budget,

@@ -50,7 +50,8 @@ from ..nn.initializer import Constant, Normal
 from ..nn.layer import Layer, LayerList
 from ..nn.layers.common import Dropout, Embedding
 from ..nn.layers.norm import LayerNorm
-from ..nn.scan import can_scan_layers, scan_layers
+from ..nn.scan import (can_scan_layers, note_scan_fallback, scan_layers,
+                       scan_layers_with_cache)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_xl"]
@@ -158,7 +159,16 @@ class GPTAttention(Layer):
         from ..tensor.manipulation import split as tsplit, squeeze
         q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
 
-        if isinstance(cache, GPTAttention.StaticCache):
+        # the serving layer is only imported once a paged cache actually
+        # arrives — training forwards (cache=None) never touch it
+        is_paged = False
+        if cache is not None and \
+                not isinstance(cache, GPTAttention.StaticCache):
+            from ..serving.kv_cache import PagedLayerCache
+            is_paged = isinstance(cache, PagedLayerCache)
+        if is_paged:
+            out, cache = self._paged_attention(x, q, k, v, cache, pos)
+        elif isinstance(cache, GPTAttention.StaticCache):
             # write this chunk's K/V into the preallocated buffers at pos
             def upd(buf, new, p):
                 return jax.lax.dynamic_update_slice(
@@ -200,6 +210,53 @@ class GPTAttention(Layer):
 
         y = apply(out_fn, out, self.out_weight, self.out_bias, name="attn_out")
         return (y, cache) if cache is not None else y
+
+    def _paged_attention(self, x, q, k, v, cache, pos):
+        """Block-table K/V path (paddle_tpu.serving, ISSUE 6).
+
+        ``cache``: :class:`~paddle_tpu.serving.kv_cache.PagedLayerCache`
+        (``[P, bs, H, D]`` pools + ``[B, MB]`` block table); ``pos``:
+        per-slot write positions ``[B]``. The chunk's K/V scatter into
+        the pools at logical positions ``pos + 0..S-1`` (a bucketed
+        prefill's padded tail routes to the scratch page). Prefill
+        (S > 1, fresh slots) attends causally over its own K/V — the
+        exact math of the full-context forward; decode (S == 1) gathers
+        the slot's pages and masks columns past ``pos``, i.e.
+        PagedAttention as one XLA gather + masked SDPA.
+        """
+        from ..serving.kv_cache import (PagedLayerCache, gather_pages,
+                                        write_pages)
+
+        def upd(pages, new, table, p):
+            return write_pages(pages, new, table, p)
+
+        kp = apply(upd, cache.k_pages, k, cache.block_table, pos,
+                   name="paged_kv_write")
+        vp = apply(upd, cache.v_pages, v, cache.block_table, pos,
+                   name="paged_kv_write")
+        new_cache = PagedLayerCache(kp, vp, cache.block_table)
+        S = x.shape[1]
+        if S > 1:
+            from ..ops.attention import scaled_dot_product_attention
+            out = scaled_dot_product_attention(
+                q, k, v, dropout_p=0.0, is_causal=True, training=False)
+            return out, new_cache
+
+        def attend(q_, kpages, vpages, table, p):
+            from ..ops.attention import sdpa_array
+            gk = gather_pages(kpages, table)
+            gv = gather_pages(vpages, table)
+            cols = jnp.arange(gk.shape[1], dtype=jnp.int32)
+            # additive key mask [B, 1, 1, Lk]: slot b sees written
+            # positions 0..p[b] (its current token included)
+            mask = jnp.where(cols[None, :] <= p[:, None].astype(jnp.int32),
+                             0.0, -1e30)[:, None, None, :]
+            return sdpa_array(q_, gk, gv, mask=mask, dropout_p=0.0,
+                              is_causal=False)
+
+        out = apply(attend, q, kp, vp, cache.block_table, pos,
+                    name="paged_attention")
+        return out, new_cache
 
 
 class GPTMLP(Layer):
@@ -260,6 +317,18 @@ class GPTDecoderLayer(Layer):
         return x if cache is None else (x, cache)
 
 
+def _paged_scan_body(template, x, cache_slices, extras):
+    """scan_layers_with_cache adapter for GPT blocks: one layer's page
+    pools in, the block's updated pools out (module-level so its identity
+    is stable in the eager jit-cache token)."""
+    from ..serving.kv_cache import PagedLayerCache
+    k_pages, v_pages = cache_slices
+    block_table, pos = extras
+    x, c = template(x, PagedLayerCache(k_pages, v_pages, block_table),
+                    pos=pos)
+    return x, (c.k_pages, c.v_pages)
+
+
 class GPTModel(Layer):
     """Embeddings + N decoder blocks + final LN. Returns hidden states."""
 
@@ -283,10 +352,24 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None,
                 cache_pos=None):
+        paged = False
+        if caches is not None:
+            # deferred so training runs never import the serving layer
+            from ..serving.kv_cache import PagedCacheView
+            paged = isinstance(caches, PagedCacheView)
         B, S = input_ids.shape
         if position_ids is None:
             from ..tensor.creation import arange
-            if cache_pos is not None:
+            if paged:
+                # per-slot positions: slot b's chunk occupies
+                # cache_pos[b] .. cache_pos[b]+S-1
+                def pos_ids(p):
+                    return (p[:, None].astype(jnp.int32)
+                            + jnp.arange(S, dtype=jnp.int32)[None, :])
+
+                position_ids = apply(pos_ids, cache_pos,
+                                     name="paged_position_ids")
+            elif cache_pos is not None:
                 position_ids = cache_pos + arange(0, S, dtype="int32")
             else:
                 start = 0 if caches is None else caches[0][0].shape[1]
@@ -298,6 +381,8 @@ class GPTModel(Layer):
         if sp:
             x = _constrain(x, BATCH, sp, None)
 
+        if paged:
+            return self._forward_paged(x, caches, cache_pos)
         if caches is not None and cache_pos is None and \
                 isinstance(caches[0], GPTAttention.StaticCache):
             raise ValueError(
@@ -316,6 +401,13 @@ class GPTModel(Layer):
                 policy=self.cfg.recompute_policy,
                 name="gpt_scan_layers")
         else:
+            if caches is not None and self.cfg.scan_layers \
+                    and can_scan_layers(self.layers):
+                # legacy per-layer StaticCache/tuple decode cannot ride
+                # the scan (per-layer python cache objects); the paged
+                # layout (paddle_tpu.serving) can — make the silent
+                # degradation loud (ISSUE 6 satellite)
+                note_scan_fallback("legacy_static_cache", "gpt")
             for i, blk in enumerate(self.layers):
                 if caches is not None:
                     x, c = blk(x, caches[i], pos=cache_pos)
@@ -326,6 +418,37 @@ class GPTModel(Layer):
                     x = blk(x)
         x = self.final_norm(x)
         return x if caches is None else (x, new_caches)
+
+    def _forward_paged(self, x, caches, cache_pos):
+        """Run the stack over a paged KV view: under scan
+        (``FLAGS_scan_decode``, default) each layer's page pools thread
+        the one ``lax.scan`` as scanned-over state — decode keeps the
+        O(1)-in-depth trace/compile cost of training; the loop layout
+        (kill switch / heterogeneous stacks) computes the same math per
+        layer."""
+        from ..core.flags import get_flag
+        from ..serving.kv_cache import PagedCacheView, PagedLayerCache
+        eligible = self.cfg.scan_layers and can_scan_layers(self.layers)
+        if eligible and get_flag("scan_decode"):
+            x, (new_k, new_v) = scan_layers_with_cache(
+                self.layers, x, (caches.k, caches.v),
+                caches.block_table, cache_pos,
+                body_call=_paged_scan_body, name="gpt_paged_scan")
+            x = self.final_norm(x)
+            return x, PagedCacheView(new_k, new_v, caches.block_table)
+        if eligible:
+            note_scan_fallback("scan_decode_disabled", "gpt")
+        from ..tensor.manipulation import stack as tstack
+        ks, vs = [], []
+        for i, blk in enumerate(self.layers):
+            layer_cache = PagedLayerCache(caches.k[i], caches.v[i],
+                                          caches.block_table)
+            x, c = blk(x, layer_cache, pos=cache_pos)
+            ks.append(c.k_pages)
+            vs.append(c.v_pages)
+        x = self.final_norm(x)
+        return x, PagedCacheView(tstack(ks, axis=0), tstack(vs, axis=0),
+                                 caches.block_table)
 
 
 def parallel_logits(hidden, embedding_weight):

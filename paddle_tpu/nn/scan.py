@@ -29,6 +29,7 @@ block's Parameter) and the scan itself is one taped ``apply`` node.
 from __future__ import annotations
 
 import contextlib
+import warnings
 import weakref
 
 import jax
@@ -38,19 +39,57 @@ from ..core.flags import get_flag
 from ..core.random import make_rng, trace_rng
 from ..core.tensor import Tensor, apply
 
-__all__ = ["can_scan_layers", "scan_layers", "invalidate_scan_cache",
-           "SCAN_STATS"]
+__all__ = ["can_scan_layers", "scan_layers", "scan_layers_with_cache",
+           "invalidate_scan_cache", "note_scan_fallback", "SCAN_STATS"]
 
 #: observability for the trace-count assertion helper
 #: (paddle_tpu.utils.compilation): ``body_traces`` counts how many times a
 #: scan body was traced at the Python level — pinned by tests to be
-#: independent of the number of layers.
-SCAN_STATS = {"body_traces": 0, "scan_calls": 0}
+#: independent of the number of layers. ``fallbacks`` counts
+#: :func:`note_scan_fallback` calls (stacks that were scan-eligible but
+#: degraded to the Python loop, e.g. legacy KV-cache decode).
+SCAN_STATS = {"body_traces": 0, "scan_calls": 0, "fallbacks": 0}
+
+#: (reason, stack) pairs already warned about — the fallback warning is
+#: one-time per cause so a decode loop does not spam stderr per step
+_FALLBACK_WARNED: set = set()
 
 
 def reset_scan_stats():
     SCAN_STATS["body_traces"] = 0
     SCAN_STATS["scan_calls"] = 0
+    SCAN_STATS["fallbacks"] = 0
+    _FALLBACK_WARNED.clear()
+
+
+def note_scan_fallback(reason: str, stack: str = "") -> None:
+    """Record that an otherwise scan-eligible stack ran as the Python
+    loop — the silent-degradation path this exists to make loud.
+
+    Emits a one-time RuntimeWarning per (reason, stack) naming the cause,
+    bumps ``SCAN_STATS['fallbacks']`` always, and (monitor mode) a
+    ``scan_fallback_total`` registry counter. Known reasons:
+    ``legacy_static_cache`` (list-of-StaticCache decode predates the
+    paged layout and has per-layer python state the scan cannot carry),
+    ``scan_decode_disabled`` (FLAGS_scan_decode kill switch).
+    """
+    SCAN_STATS["fallbacks"] += 1
+    key = (reason, stack)
+    if key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(key)
+        warnings.warn(
+            f"scan-over-layers fell back to the per-layer Python loop for "
+            f"{stack or 'a layer stack'} (reason: {reason}); trace/compile "
+            "cost is O(num_layers) on this path. Paged-KV decode "
+            "(paddle_tpu.serving) runs under scan; FLAGS_scan_decode "
+            "controls it.", RuntimeWarning, stacklevel=3)
+    from ..monitor import enabled as _mon_enabled
+    if _mon_enabled():
+        from ..monitor import get_registry
+        get_registry().counter(
+            "scan_fallback_total",
+            "scan-eligible stacks that degraded to the per-layer Python "
+            "loop, by cause").inc(reason=reason, stack=stack)
 
 
 def _config_sig(block):
@@ -256,3 +295,89 @@ def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
              _config_sig(template))
     return apply(_scan_fn, x_t, *key_args, *flat_params, *extra, name=name,
                  _cache_token=token)
+
+
+def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
+                           name: str = "scan_layers_cache"):
+    """Run ``x`` through ``blocks`` as ONE ``jax.lax.scan`` while
+    threading per-layer cache state — the decode-time counterpart of
+    :func:`scan_layers` (the paged-KV serving path, ISSUE 6).
+
+    ``cache``: tuple of Tensors/arrays stacked along a leading layer
+    axis (``[L, ...]`` — e.g. per-layer K/V page pools); each layer's
+    slice enters the scan as a scanned-over input and the updated slice
+    leaves as a scanned-over output, so the whole decode step stays one
+    O(1)-trace program. ``extra``: broadcast (non-scanned) arguments
+    shared by every layer (block tables, per-slot positions).
+
+    ``body_call(template, x, cache_slices, extras)`` adapts the generic
+    scan to the stack's block signature: it must run ``template`` (the
+    first block, with that layer's params bound) and return
+    ``(x, new_cache_slices)`` with ``new_cache_slices`` matching
+    ``cache``'s structure and per-layer shapes. Pass a module-level
+    function — its identity rides the eager jit-cache token.
+
+    Eval-mode only (decode never trains): a training-mode template is
+    rejected rather than silently dropping dropout randomness.
+
+    Returns ``(y, new_cache)`` with ``new_cache`` stacked ``[L, ...]``.
+    """
+    blocks = list(blocks)
+    template = blocks[0]
+    num_layers = len(blocks)
+    if bool(getattr(template, "training", False)):
+        raise ValueError(
+            "scan_layers_with_cache is an eval/decode path; call "
+            "model.eval() first (training-mode dropout would need a "
+            "per-layer RNG this cache-threading scan does not carry)")
+
+    from ..jit.functional import bind as bind_
+
+    names = [n for n, _ in template.named_parameters()]
+    specs = {n: getattr(p, "spec", None)
+             for n, p in template.named_parameters()}
+    per_block = [dict(b.named_parameters()) for b in blocks]
+    flat_params = [pb[n] for n in names for pb in per_block]
+    n_cache = len(cache)
+
+    SCAN_STATS["scan_calls"] += 1
+
+    def _scan_fn(x_arr, *arrs):
+        n_p = len(names) * num_layers
+        p_stacked = {
+            n: jnp.stack(arrs[i * num_layers:(i + 1) * num_layers], axis=0)
+            for i, n in enumerate(names)}
+        cache_raw = arrs[n_p:n_p + n_cache]
+        extra_raw = arrs[n_p + n_cache:]
+        # same stacked-layout TP pins as the training scan (leading layer
+        # axis replicated); no-op without an active mesh
+        from ..distributed.spmd import constrain
+        for n in names:
+            sp = specs[n]
+            if sp is not None:
+                p_stacked[n] = constrain(p_stacked[n], None, *tuple(sp))
+
+        def body(carry, xs):
+            SCAN_STATS["body_traces"] += 1
+            p_slice, cache_slice = xs
+            with bind_(template, p_slice, None):
+                out, new_cache = body_call(
+                    template, Tensor(carry),
+                    tuple(Tensor(c) for c in cache_slice),
+                    tuple(Tensor(e) if hasattr(e, "dtype") else e
+                          for e in extra_raw))
+            out = out._data if isinstance(out, Tensor) else out
+            new_cache = tuple(c._data if isinstance(c, Tensor) else c
+                              for c in new_cache)
+            return out.astype(carry.dtype), new_cache
+
+        y, new_cache_stacked = jax.lax.scan(
+            body, x_arr, (p_stacked, tuple(cache_raw)))
+        return (y,) + tuple(new_cache_stacked)
+
+    x_t = x if isinstance(x, Tensor) else Tensor(x)
+    token = ("scan_layers_cache", name, id(template), num_layers, n_cache,
+             len(extra), id(body_call), _config_sig(template))
+    out = apply(_scan_fn, x_t, *flat_params, *cache, *extra, name=name,
+                _cache_token=token)
+    return out[0], tuple(out[1:])

@@ -1,0 +1,58 @@
+"""paddle_tpu.serving — TPU-native inference serving runtime (ISSUE 6).
+
+The path from a trained model to traffic (ROADMAP item 1, the
+millions-of-users north star), built on two ideas from the serving
+literature mapped onto static-shape XLA programs:
+
+- **paged KV decode** (:mod:`.kv_cache`): block-structured K/V pools
+  shared by all requests with per-slot block tables — the
+  vLLM/PagedAttention memory model, generalized from ``StaticCache`` so
+  it composes with scan-over-layers
+  (``nn.scan.scan_layers_with_cache``, ``FLAGS_scan_decode``);
+- **continuous batching** (:mod:`.scheduler`): iteration-level
+  admission/eviction into fixed batch slots (Orca), with bucketed
+  ``(batch, prefill_len)`` prefill shapes bounding the compile count
+  and recompute-preemption when the page pool runs dry;
+- the :class:`~.engine.ServingEngine` glues them behind AOT-compiled
+  serving signatures (``jit.aot.AOTProgram``, the TrainStep machinery),
+  streaming per-token callbacks and TTFT/TPOT/throughput metrics into
+  the :mod:`paddle_tpu.monitor` registry;
+- :mod:`.loadgen` is the synthetic open-loop driver behind
+  ``bench.py --serve`` (the ``BENCH_serve`` record).
+
+See docs/SERVING.md for architecture, bucketing policy and the flag
+matrix.
+"""
+
+from .detok import StreamingDetokenizer  # noqa: F401
+from .engine import ServingConfig, ServingEngine  # noqa: F401
+from .kv_cache import (BlockAllocator, PagedCacheView,  # noqa: F401
+                       PagedKVCache, PagedLayerCache)
+from .loadgen import LoadSpec, build_requests, run_open_loop  # noqa: F401
+from .sampling import SamplingParams, sample_tokens  # noqa: F401
+from .scheduler import BucketTable, Request, Scheduler  # noqa: F401
+
+__all__ = [
+    "ServingConfig", "ServingEngine", "Request", "SamplingParams",
+    "BucketTable", "Scheduler", "PagedKVCache", "PagedCacheView",
+    "PagedLayerCache", "BlockAllocator", "StreamingDetokenizer",
+    "LoadSpec", "build_requests", "run_open_loop", "reset",
+]
+
+
+def reset() -> None:
+    """Tear down process-global serving state (conftest autouse): shut
+    down live engines, restart the request-id counter, and clear the
+    scan-fallback warn-once set + counter so fallback-telemetry
+    assertions are order-independent."""
+    from . import engine as _engine, scheduler as _scheduler
+    from ..nn import scan as _scan
+    for e in list(_engine._LIVE_ENGINES):
+        try:
+            e.shutdown()
+        except Exception:
+            pass
+    _engine._LIVE_ENGINES.clear()
+    _scheduler._reset_request_ids()
+    _scan.SCAN_STATS["fallbacks"] = 0
+    _scan._FALLBACK_WARNED.clear()

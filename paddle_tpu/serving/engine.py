@@ -1,0 +1,538 @@
+"""TPU-native LLM serving engine: paged decode + continuous batching
+behind AOT-compiled serving signatures.
+
+The execution model (ISSUE 6; docs/SERVING.md):
+
+- **two program kinds**, split the way TPU serving wants them: a
+  *prefill* program per ``(batch, prefill_len)`` bucket (prompt forward,
+  K/V written into the paged pools, first token sampled) and ONE
+  *decode* program over the full slot batch (single-token forward via
+  the block tables, next token sampled per slot, inactive slots masked).
+  Both are built with :class:`paddle_tpu.jit.aot.AOTProgram` — the same
+  lower/compile machinery as ``TrainStep`` — so executables exist before
+  traffic arrives (``warmup()``) and per-program HBM/FLOPs attribution
+  comes from the exact executables that serve;
+- **continuous batching**: the :class:`~.scheduler.Scheduler` admits and
+  evicts requests between decode steps; every decode dispatch serves
+  whatever mix of requests currently holds slots (block tables, write
+  positions, sampling params and the active mask are all ARGUMENTS, so
+  membership changes never recompile);
+- **decode under scan**: with ``FLAGS_scan_decode`` (default on) the
+  layer stack runs as one ``lax.scan`` threading each layer's K/V pages
+  (``nn.scan.scan_layers_with_cache``) — O(1) trace/compile in depth,
+  same as training;
+- **telemetry**: per-request TTFT / TPOT / end-to-end latency and
+  queue/occupancy gauges stream into the ``paddle_tpu.monitor`` registry
+  (serving metrics are always on — an engine exists to be observed; the
+  FLAGS_monitor zero-write contract covers the *training* hot path), and
+  ``metrics_summary()`` computes the p50/p99 numbers ``bench.py
+  --serve`` records.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..core.random import trace_rng
+from ..jit.aot import AOTProgram
+from ..jit.functional import bind, buffer_arrays, param_arrays
+from ..monitor import get_registry
+from .detok import StreamingDetokenizer
+from .kv_cache import PagedCacheView, PagedKVCache, blocks_needed
+from .sampling import SamplingParams, sample_tokens
+from .scheduler import (AdmissionGroup, BucketTable, Request, RequestState,
+                        Scheduler)
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+#: live engines, for test isolation (serving.reset shuts them down)
+_LIVE_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+
+
+def _pow2_buckets(lo: int, hi: int) -> Tuple[int, ...]:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+@dataclass
+class ServingConfig:
+    """Engine sizing + policy.
+
+    ``max_context_len`` bounds prompt+generation per request;
+    ``num_pages`` sizes the shared KV pool (default: full residency for
+    every slot, i.e. no preemption pressure — shrink it to trade HBM for
+    recompute-preemptions). ``prefill_buckets``/``batch_buckets`` ARE the
+    compile budget: one prefill executable per pair actually used.
+    """
+
+    max_batch_slots: int = 8
+    block_size: int = 16
+    max_context_len: int = 512
+    num_pages: Optional[int] = None
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    batch_buckets: Tuple[int, ...] = (1, 2, 4)
+    max_queue: int = 1024
+    seed: int = 0
+    cache_dtype: str = "float32"
+    detokenizer: Optional[StreamingDetokenizer] = None
+
+    def resolve(self, model_max_positions: Optional[int]) -> None:
+        if model_max_positions is not None:
+            self.max_context_len = min(self.max_context_len,
+                                       int(model_max_positions))
+        if self.prefill_buckets is None:
+            lo = min(max(self.block_size, 16), self.max_context_len)
+            self.prefill_buckets = _pow2_buckets(lo, self.max_context_len)
+        else:
+            self.prefill_buckets = tuple(
+                min(int(b), self.max_context_len)
+                for b in self.prefill_buckets)
+            if max(self.prefill_buckets) < self.max_context_len:
+                # preemption re-prefills prompt+generated-so-far; the
+                # table must cover the worst case
+                self.prefill_buckets += (self.max_context_len,)
+        self.batch_buckets = tuple(
+            min(int(b), self.max_batch_slots) for b in self.batch_buckets)
+        if self.num_pages is None:
+            per_slot = blocks_needed(self.max_context_len, self.block_size)
+            self.num_pages = 1 + self.max_batch_slots * per_slot
+
+
+class ServingEngine:
+    """Serve a decoder-only model (GPT-style ``forward(input_ids,
+    caches=<PagedCacheView>, cache_pos=<[B] positions>)`` returning
+    ``(logits, new_caches)``) with continuous batching."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 clock=time.perf_counter):
+        self.model = model
+        cfg = getattr(model, "cfg", None)
+        if cfg is None:
+            raise ValueError("ServingEngine needs a model with a .cfg "
+                             "(num_heads/head_dim/num_layers)")
+        import dataclasses
+        # resolve() fills model-dependent defaults — work on a copy so a
+        # caller-owned config can be reused across engines/models
+        self.config = dataclasses.replace(config) if config is not None \
+            else ServingConfig()
+        self.config.resolve(getattr(cfg, "max_position_embeddings", None))
+        self.clock = clock
+        model.eval()
+        self.params = param_arrays(model)
+        self.buffers = buffer_arrays(model)
+        c = self.config
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_heads, cfg.head_dim,
+            num_pages=c.num_pages, block_size=c.block_size,
+            max_slots=c.max_batch_slots,
+            max_blocks_per_slot=blocks_needed(c.max_context_len,
+                                              c.block_size),
+            dtype=jnp.dtype(c.cache_dtype))
+        self.buckets = BucketTable(c.prefill_buckets, c.batch_buckets)
+        self.scheduler = Scheduler(self.cache, self.buckets,
+                                   max_queue=c.max_queue, clock=clock,
+                                   max_seq_len=c.max_context_len)
+        self._programs: Dict[tuple, AOTProgram] = {}
+        self._programs_info: Dict[str, dict] = {}
+        self._key = jax.random.key(int(c.seed))
+        self._dispatch_seq = 0
+        self._stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
+                       "decode_slot_steps": 0, "decode_batch_max": 0,
+                       "tokens_generated": 0, "program_compiles": 0}
+        self._lat: Dict[str, List[float]] = {
+            "ttft": [], "tpot": [], "e2e": [], "decode_step": []}
+        self._t_first_work: Optional[float] = None
+        self._t_last_token: Optional[float] = None
+        _LIVE_ENGINES.add(self)
+
+    # -- program construction ----------------------------------------------
+    def _next_key(self):
+        self._dispatch_seq += 1
+        return jax.random.fold_in(self._key, self._dispatch_seq)
+
+    def _fwd(self, params, ids, k, v, table, pos):
+        """Pure model forward over the paged view (traced inside the
+        prefill/decode programs)."""
+        view = PagedCacheView(Tensor(k), Tensor(v), Tensor(table))
+        with bind(self.model, params, dict(self.buffers)), no_grad(), \
+                trace_rng(jax.random.key(0)):
+            logits, new = self.model(Tensor(ids), caches=view,
+                                     cache_pos=Tensor(pos))
+        unw = lambda t: t._data if isinstance(t, Tensor) else t
+        return unw(logits), unw(new.k), unw(new.v)
+
+    def _attribute(self, kind: str, lowered, compiled) -> None:
+        """Per-program attribution from the serving executables (same
+        sources as TrainStep: lowered.cost_analysis /
+        compiled.memory_analysis)."""
+        self._stats["program_compiles"] += 1
+        entry: dict = {}
+        try:
+            from ..cost_model import CostModel
+            entry = CostModel().attribute(lowered)
+        except Exception:
+            pass
+        try:
+            from ..monitor import memory as monitor_memory
+            pm = monitor_memory.analyze_compiled(compiled, kind=kind)
+            if pm is not None:
+                entry["peak_hbm_bytes"] = pm.peak_bytes
+                monitor_memory.record_program(pm)
+                get_registry().gauge(
+                    "serve_program_peak_hbm_bytes",
+                    "static peak-HBM estimate per serving program"
+                ).set(pm.peak_bytes, kind=kind)
+        except Exception:
+            pass
+        self._programs_info[kind] = entry
+        get_registry().counter(
+            "serve_program_compiles_total",
+            "serving executable builds by program kind").inc(kind=kind)
+
+    def _donate(self) -> tuple:
+        from ..jit.to_static import _donation_safe
+        # pools are the 2nd/3rd argument of both program kinds; donation
+        # keeps decode's HBM footprint at ONE pool copy (skipped on the
+        # cpu+persistent-cache test backend — the jax 0.4.37 scan+donate
+        # aliasing hazard, see _donation_safe)
+        return (1, 2) if _donation_safe() else ()
+
+    def _get_decode(self) -> AOTProgram:
+        key = ("decode",)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        def decode_fn(params, k, v, table, pos, tokens, active, rng,
+                      temps, top_ks, top_ps):
+            logits, k, v = self._fwd(params, tokens[:, None], k, v,
+                                     table, pos)
+            toks = sample_tokens(logits[:, -1, :], rng, temps, top_ks,
+                                 top_ps)
+            return jnp.where(active, toks, 0), k, v
+
+        B = self.config.max_batch_slots
+        mb = self.cache.max_blocks_per_slot
+        prog = AOTProgram("serve_decode", decode_fn,
+                          donate_argnums=self._donate(),
+                          on_attribute=self._attribute)
+        prog.compile((self.params, self.cache.k, self.cache.v,
+                      jnp.zeros((B, mb), jnp.int32),
+                      jnp.zeros((B,), jnp.int32),
+                      jnp.zeros((B,), jnp.int32),
+                      jnp.zeros((B,), bool), self._key,
+                      jnp.ones((B,), jnp.float32),
+                      jnp.zeros((B,), jnp.int32),
+                      jnp.ones((B,), jnp.float32)))
+        self._programs[key] = prog
+        return prog
+
+    def _get_prefill(self, nb: int, sp: int) -> AOTProgram:
+        key = ("prefill", nb, sp)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        def prefill_fn(params, k, v, table, ids, lens, rng, temps,
+                       top_ks, top_ps):
+            pos = jnp.zeros((nb,), jnp.int32)
+            logits, k, v = self._fwd(params, ids, k, v, table, pos)
+            last = jnp.take_along_axis(
+                logits, (lens - 1).astype(jnp.int32)[:, None, None],
+                axis=1)[:, 0, :]
+            toks = sample_tokens(last, rng, temps, top_ks, top_ps)
+            return toks, k, v
+
+        mb = self.cache.max_blocks_per_slot
+        prog = AOTProgram(f"serve_prefill_b{nb}_s{sp}", prefill_fn,
+                          donate_argnums=self._donate(),
+                          on_attribute=self._attribute)
+        prog.compile((self.params, self.cache.k, self.cache.v,
+                      jnp.zeros((nb, mb), jnp.int32),
+                      jnp.zeros((nb, sp), jnp.int32),
+                      jnp.ones((nb,), jnp.int32), self._key,
+                      jnp.ones((nb,), jnp.float32),
+                      jnp.zeros((nb,), jnp.int32),
+                      jnp.ones((nb,), jnp.float32)))
+        self._programs[key] = prog
+        return prog
+
+    def warmup(self, prefill_signatures: Optional[Sequence[Tuple[int, int]]]
+               = None) -> int:
+        """AOT-compile the decode program and the given (or full bucket
+        table's) prefill signatures before traffic arrives. Returns the
+        number of programs now resident."""
+        self._get_decode()
+        for nb, sp in (prefill_signatures
+                       if prefill_signatures is not None
+                       else self.buckets.signatures()):
+            self._get_prefill(nb, sp)
+        return len(self._programs)
+
+    #: raw latency samples kept per series for exact percentiles; beyond
+    #: this the oldest half is dropped (a long-running engine must not
+    #: grow host memory per request — summaries then cover the recent
+    #: window, which is what an SLO dashboard wants anyway)
+    LAT_WINDOW = 65536
+
+    def _observe(self, series: str, value: float) -> None:
+        lst = self._lat[series]
+        lst.append(value)
+        if len(lst) > 2 * self.LAT_WINDOW:
+            del lst[:len(lst) - self.LAT_WINDOW]
+
+    # -- request surface ----------------------------------------------------
+    def submit(self, request: Request) -> RequestState:
+        st = self.scheduler.submit(request)
+        get_registry().counter(
+            "serve_requests_total",
+            "serving requests by lifecycle event").inc(event="submitted")
+        self._publish_gauges()
+        return st
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 16,
+                 sampling: Optional[SamplingParams] = None,
+                 eos_token_id: Optional[int] = None) -> List[np.ndarray]:
+        """Batch convenience: submit, drain, return full sequences
+        (prompt + generated) per request, in submission order."""
+        states = [self.submit(Request(
+            p, max_new_tokens=max_new_tokens,
+            sampling=sampling or SamplingParams(),
+            eos_token_id=eos_token_id)) for p in prompts]
+        self.run()
+        return [np.concatenate([st.request.prompt,
+                                np.asarray(st.generated, np.int32)])
+                for st in states]
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive the scheduler until the queue and slots drain."""
+        steps = 0
+        while self.scheduler.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return
+
+    # -- the serving iteration ----------------------------------------------
+    def step(self) -> bool:
+        """One scheduler iteration: admit+prefill, then one decode
+        dispatch over every active slot. Returns has_work."""
+        for group in self.scheduler.plan_admissions():
+            self._run_prefill(group)
+        if self.scheduler.active():
+            self.scheduler.ensure_decode_capacity()
+            if self.scheduler.active():
+                self._run_decode()
+        self._publish_gauges()
+        return self.scheduler.has_work
+
+    def _sampling_arrays(self, states: Sequence[Optional[RequestState]]):
+        n = len(states)
+        temps = np.ones((n,), np.float32)
+        tks = np.zeros((n,), np.int32)
+        tps = np.ones((n,), np.float32)
+        for i, st in enumerate(states):
+            if st is None:
+                continue
+            s = st.request.sampling
+            temps[i], tks[i], tps[i] = s.temperature, s.top_k, s.top_p
+        return jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps)
+
+    def _run_prefill(self, group: AdmissionGroup) -> None:
+        nb, sp = group.batch_bucket, group.len_bucket
+        states: List[Optional[RequestState]] = list(group.states)
+        states += [None] * (nb - len(states))
+        ids = np.zeros((nb, sp), np.int32)
+        lens = np.ones((nb,), np.int32)
+        # padded rows map to None -> an all-scratch table row (their
+        # K/V writes must never land in a live slot's pages)
+        rows: List[Optional[int]] = [None] * nb
+        for i, st in enumerate(states):
+            if st is None:
+                continue
+            eff = st.effective_prompt()
+            ids[i, :eff.size] = eff
+            lens[i] = eff.size
+            rows[i] = st.slot
+        t0 = self.clock()
+        if self._t_first_work is None:
+            self._t_first_work = t0
+        prog = self._get_prefill(nb, sp)
+        temps, tks, tps = self._sampling_arrays(states)
+        toks, new_k, new_v = prog(
+            self.params, self.cache.k, self.cache.v,
+            self.cache.table_array(rows), jnp.asarray(ids),
+            jnp.asarray(lens), self._next_key(), temps, tks, tps)
+        self.cache.update(new_k, new_v)
+        toks = np.asarray(toks)
+        now = self.clock()
+        self._stats["prefill_dispatches"] += 1
+        reg = get_registry()
+        reg.histogram("serve_prefill_seconds",
+                      "prefill dispatch wall time").observe(
+            now - t0, bucket=f"b{nb}_s{sp}")
+        for i, st in enumerate(states):
+            if st is None:
+                continue
+            self._accept_token(st, int(toks[i]), now)
+
+    def _run_decode(self) -> None:
+        B = self.config.max_batch_slots
+        pos = np.zeros((B,), np.int32)
+        tokens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        per_slot: List[Optional[RequestState]] = [None] * B
+        for slot, st in self.scheduler.active():
+            # the newest generated token is not yet in the cache: this
+            # step writes its K/V at position seq_len-1 and attends over
+            # everything up to and including it
+            pos[slot] = st.seq_len - 1
+            tokens[slot] = st.generated[-1]
+            active[slot] = True
+            per_slot[slot] = st
+        n_active = int(active.sum())
+        t0 = self.clock()
+        prog = self._get_decode()
+        temps, tks, tps = self._sampling_arrays(per_slot)
+        toks, new_k, new_v = prog(
+            self.params, self.cache.k, self.cache.v,
+            self.cache.table_array(), jnp.asarray(pos),
+            jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
+            temps, tks, tps)
+        self.cache.update(new_k, new_v)
+        toks = np.asarray(toks)
+        now = self.clock()
+        dt = now - t0
+        st_ = self._stats
+        st_["decode_dispatches"] += 1
+        st_["decode_slot_steps"] += n_active
+        st_["decode_batch_max"] = max(st_["decode_batch_max"], n_active)
+        self._observe("decode_step", dt)
+        reg = get_registry()
+        reg.histogram("serve_decode_step_seconds",
+                      "decode dispatch wall time (all slots)").observe(dt)
+        reg.histogram("serve_decode_occupancy",
+                      "active slots per decode dispatch",
+                      buckets=tuple(range(1, B + 1))).observe(n_active)
+        for slot, st in list(self.scheduler.active()):
+            self._accept_token(st, int(toks[slot]), now)
+
+    def _accept_token(self, st: RequestState, token: int,
+                      now: float) -> None:
+        first = st.first_token_t is None
+        if first:
+            st.first_token_t = now
+            ttft = now - st.submitted_t
+            self._observe("ttft", ttft)
+            get_registry().histogram(
+                "serve_ttft_seconds",
+                "submit -> first token latency").observe(ttft)
+        st.generated.append(token)
+        self._stats["tokens_generated"] += 1
+        self._t_last_token = now
+        get_registry().counter(
+            "serve_tokens_generated_total",
+            "tokens sampled across all requests").inc()
+        req = st.request
+        if req.on_token is not None:
+            text = None
+            if self.config.detokenizer is not None:
+                text = self.config.detokenizer.piece(
+                    token, is_first=len(st.generated) == 1)
+            req.on_token(req, token, text)
+        if st.is_done():
+            self.scheduler.finish(st)
+            e2e = now - st.submitted_t
+            self._observe("e2e", e2e)
+            n = len(st.generated)
+            if n > 1 and st.first_token_t is not None:
+                tpot = (now - st.first_token_t) / (n - 1)
+                self._observe("tpot", tpot)
+                get_registry().histogram(
+                    "serve_tpot_seconds",
+                    "mean per-token decode latency per request"
+                ).observe(tpot)
+            reg = get_registry()
+            reg.histogram("serve_e2e_seconds",
+                          "submit -> completion latency").observe(e2e)
+            reg.counter("serve_requests_total",
+                        "serving requests by lifecycle event"
+                        ).inc(event="completed")
+
+    def _publish_gauges(self) -> None:
+        reg = get_registry()
+        reg.gauge("serve_queue_depth",
+                  "requests waiting for a batch slot").set(
+            self.scheduler.queue_depth)
+        reg.gauge("serve_active_slots", "requests holding a batch slot"
+                  ).set(len(self.scheduler.active()))
+        reg.gauge("serve_kv_pages_in_use",
+                  "allocated KV pages (of the shared pool)").set(
+            self.cache.allocator.pages_in_use)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        d = dict(self._stats)
+        d.update(self.scheduler.stats)
+        d["programs"] = dict(self._programs_info)
+        d["resident_programs"] = len(self._programs)
+        d["queue_depth"] = self.scheduler.queue_depth
+        d["active_slots"] = len(self.scheduler.active())
+        d["kv_pages_in_use"] = self.cache.allocator.pages_in_use
+        return d
+
+    def metrics_summary(self) -> dict:
+        """Host-side latency/throughput summary (exact percentiles over
+        the raw per-request samples — the BENCH_serve payload)."""
+
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+        elapsed = None
+        if self._t_first_work is not None and \
+                self._t_last_token is not None:
+            elapsed = max(self._t_last_token - self._t_first_work, 1e-9)
+        lat = self._lat
+        return {
+            "requests_completed": self.scheduler.stats["completed"],
+            "preemptions": self.scheduler.stats["preemptions"],
+            "tokens_generated": self._stats["tokens_generated"],
+            "elapsed_s": elapsed,
+            "tokens_per_sec": (self._stats["tokens_generated"] / elapsed
+                               if elapsed else None),
+            "ttft_p50_s": pct(lat["ttft"], 50),
+            "ttft_p99_s": pct(lat["ttft"], 99),
+            "tpot_p50_s": pct(lat["tpot"], 50),
+            "tpot_p99_s": pct(lat["tpot"], 99),
+            "decode_step_p50_s": pct(lat["decode_step"], 50),
+            "decode_step_p99_s": pct(lat["decode_step"], 99),
+            "decode_dispatches": self._stats["decode_dispatches"],
+            "mean_decode_occupancy": (
+                self._stats["decode_slot_steps"]
+                / self._stats["decode_dispatches"]
+                if self._stats["decode_dispatches"] else None),
+        }
+
+    def shutdown(self) -> None:
+        """Drop compiled programs and cache pools (test isolation /
+        explicit teardown)."""
+        self._programs.clear()
+        self.scheduler.waiting.clear()
+        for slot, _ in list(self.scheduler.active()):
+            self.cache.free_slot(slot)
+            self.scheduler.slots[slot] = None
+        self.cache.k = self.cache.v = None

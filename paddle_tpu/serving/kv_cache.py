@@ -1,0 +1,227 @@
+"""Paged KV cache: block-structured decode state with static XLA shapes.
+
+The vLLM/PagedAttention (SOSP '23) memory model mapped onto a TPU-native
+static-shape program — the generalization of ``GPTAttention.StaticCache``
+(one contiguous ``[B, L_max, H, D]`` buffer per request) to a shared pool
+of fixed-size pages:
+
+- K/V live in ONE pool per layer, ``[num_pages, block_size, H, D]``,
+  stacked ``[L, ...]`` at the model level so scan-over-layers can thread
+  each layer's slice through the decode program
+  (:func:`paddle_tpu.nn.scan.scan_layers_with_cache`);
+- each batch slot owns a row of a **block table** ``[slots, MB]`` mapping
+  logical block ``j`` (token positions ``j*bs .. j*bs+bs-1``) to a
+  physical page; unallocated entries point at the reserved scratch page 0;
+- pages are allocated incrementally as a request's sequence grows and
+  freed the step it finishes — HBM scales with tokens actually held, not
+  with ``slots * max_context`` (the fragmentation PagedAttention exists
+  to kill), and the page pool size is the admission-control currency the
+  scheduler trades in;
+- every device shape is static: block tables and per-slot positions are
+  small int32 *arguments* of the compiled step, so admitting/evicting a
+  request between steps never recompiles anything.
+
+The write/gather kernels are plain XLA scatter/gather (TPU-friendly:
+one ``.at[].set`` and one ``pages[table]`` gather per layer); out-of-range
+logical positions (a bucketed prefill's padded tail) route to the scratch
+page by construction and are masked at read time, so no branch guards the
+hot path.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView",
+           "PagedLayerCache", "write_pages", "gather_pages",
+           "blocks_needed"]
+
+#: physical page 0 is never allocated: it is the shared scratch target for
+#: writes from inactive slots and padded prefill tails, and is masked out
+#: of every read
+SCRATCH_PAGE = 0
+
+
+def blocks_needed(num_tokens: int, block_size: int) -> int:
+    return max(0, math.ceil(int(num_tokens) / int(block_size)))
+
+
+class PagedCacheView(NamedTuple):
+    """Model-level traced view of the cache: what ``GPTModel.forward``
+    receives as ``caches``. ``k``/``v`` are layer-stacked pools
+    ``[L, P, bs, H, D]``; ``block_table`` is ``[B, MB]`` int32. Being a
+    NamedTuple it is a pytree — it flows through jit/scan unchanged."""
+
+    k: object
+    v: object
+    block_table: object
+
+
+class PagedLayerCache(NamedTuple):
+    """One layer's slice of the view (``[P, bs, H, D]`` pools), handed to
+    ``GPTAttention.forward`` by both the scan body and the loop layout."""
+
+    k_pages: object
+    v_pages: object
+    block_table: object
+
+
+def write_pages(pages, new, block_table, pos):
+    """Scatter ``new`` ``[B, S, H, D]`` into ``pages`` ``[P, bs, H, D]``
+    at logical positions ``pos[b] + 0..S-1`` through ``block_table``
+    ``[B, MB]``. Positions past ``MB*bs`` (padded prefill tails) route to
+    the scratch page. Returns the updated pool."""
+    bs = pages.shape[1]
+    mb = block_table.shape[1]
+    S = new.shape[1]
+    idx = pos[:, None].astype(jnp.int32) + \
+        jnp.arange(S, dtype=jnp.int32)[None, :]                  # [B, S]
+    blk_logical = jnp.minimum(idx // bs, mb - 1)
+    blk = jnp.take_along_axis(block_table, blk_logical, axis=1)  # [B, S]
+    blk = jnp.where(idx >= bs * mb, SCRATCH_PAGE, blk)
+    off = idx % bs
+    return pages.at[blk, off].set(new.astype(pages.dtype))
+
+
+def gather_pages(pages, block_table):
+    """Gather a slot-contiguous context ``[B, MB*bs, H, D]`` out of the
+    pool via the block table (the PagedAttention read)."""
+    g = pages[block_table]                        # [B, MB, bs, H, D]
+    B, MB, bs, H, D = g.shape
+    return g.reshape(B, MB * bs, H, D)
+
+
+class BlockAllocator:
+    """Host-side free list over the physical page pool (page 0 reserved
+    as scratch). O(1) alloc/free; allocation is all-or-nothing so a
+    half-admitted request never wedges the pool."""
+
+    def __init__(self, num_pages: int, reserved: int = 1):
+        if num_pages <= reserved:
+            raise ValueError(
+                f"page pool of {num_pages} leaves nothing to allocate "
+                f"({reserved} reserved)")
+        self.num_pages = int(num_pages)
+        self.reserved = int(reserved)
+        self._free = collections.deque(range(reserved, num_pages))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - self.reserved - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None (and no change) when the pool cannot cover
+        them — the scheduler's cue to wait or preempt."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not (self.reserved <= p < self.num_pages):
+                raise ValueError(f"freeing page {p} outside the pool")
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Device page pools + host block tables for a fixed slot batch.
+
+    ``update(new_k, new_v)`` swaps in the pools a compiled step returned;
+    ``table_array()`` snapshots the host tables as the step's int32
+    argument. Slot bookkeeping (``alloc_slot``/``extend_slot``/
+    ``free_slot``) is pure host work — device shapes never change.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 *, num_pages: int, block_size: int, max_slots: int,
+                 max_blocks_per_slot: int, dtype=jnp.float32):
+        self.num_layers = int(num_layers)
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.max_blocks_per_slot = int(max_blocks_per_slot)
+        shape = (num_layers, num_pages, block_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(num_pages)
+        self._tables = np.full((max_slots, max_blocks_per_slot),
+                               SCRATCH_PAGE, np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+
+    # -- device-side --------------------------------------------------------
+    def update(self, new_k, new_v) -> None:
+        self.k, self.v = new_k, new_v
+
+    def table_array(self, rows: Optional[Sequence[Optional[int]]] = None):
+        """Snapshot block tables as the step's int32 argument: all slots,
+        or one row per entry of ``rows`` — a ``None`` entry (a padded
+        prefill row) gets an all-scratch row, so its garbage K/V can
+        never land in another slot's pages."""
+        if rows is None:
+            return jnp.asarray(self._tables)
+        t = np.full((len(rows), self.max_blocks_per_slot), SCRATCH_PAGE,
+                    np.int32)
+        for i, s in enumerate(rows):
+            if s is not None:
+                t[i] = self._tables[s]
+        return jnp.asarray(t)
+
+    @property
+    def max_context_len(self) -> int:
+        return self.max_blocks_per_slot * self.block_size
+
+    # -- slot bookkeeping ---------------------------------------------------
+    def slot_blocks(self, slot: int) -> int:
+        return len(self._slot_pages[slot])
+
+    def capacity_tokens(self, slot: int) -> int:
+        """Token positions the slot's allocated blocks cover."""
+        return self.slot_blocks(slot) * self.block_size
+
+    def alloc_slot(self, slot: int, num_tokens: int) -> bool:
+        """Allocate blocks covering ``num_tokens`` positions for a fresh
+        slot. False (state untouched) when the pool cannot cover it."""
+        if self._slot_pages[slot]:
+            raise RuntimeError(f"slot {slot} already holds pages; "
+                               "free_slot first")
+        pages = self.allocator.alloc(
+            blocks_needed(num_tokens, self.block_size))
+        if pages is None:
+            return False
+        self._slot_pages[slot] = pages
+        self._tables[slot, :len(pages)] = pages
+        return True
+
+    def extend_slot(self, slot: int, num_tokens: int) -> bool:
+        """Grow the slot to cover ``num_tokens`` positions (decode
+        crossing a block boundary). False when the pool is dry — the
+        preemption trigger."""
+        need = blocks_needed(num_tokens, self.block_size)
+        have = len(self._slot_pages[slot])
+        if need <= have:
+            return True
+        if need > self.max_blocks_per_slot:
+            raise ValueError(
+                f"slot {slot}: {num_tokens} tokens exceed the "
+                f"{self.max_context_len}-token slot capacity")
+        pages = self.allocator.alloc(need - have)
+        if pages is None:
+            return False
+        self._slot_pages[slot].extend(pages)
+        self._tables[slot, have:need] = pages
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        pages = self._slot_pages[slot]
+        if pages:
+            self.allocator.free(pages)
+        self._slot_pages[slot] = []
+        self._tables[slot, :] = SCRATCH_PAGE

@@ -1,0 +1,87 @@
+"""Synthetic open-loop load generator for the serving engine.
+
+Open-loop means arrivals follow a FIXED schedule (Poisson process at
+``rate_rps``) regardless of how fast the engine drains — the honest way
+to measure serving latency: a closed-loop driver (next request only
+after the previous completes) hides queueing delay exactly when the
+system saturates. Prompt and generation lengths are drawn per request
+from uniform ranges; everything is seeded, so a load run replays
+exactly (the same property the chaos harness pins for faults).
+
+``run_open_loop`` drives the engine inline: it submits every request
+whose arrival time has passed, then runs one engine step, until the
+schedule is exhausted and the engine drains. ``time_scale`` compresses
+the schedule for tests (arrivals only — measured latencies are real).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .sampling import SamplingParams
+from .scheduler import Request
+
+__all__ = ["LoadSpec", "build_requests", "run_open_loop"]
+
+
+@dataclass
+class LoadSpec:
+    num_requests: int = 16
+    rate_rps: float = 4.0
+    prompt_len_range: Tuple[int, int] = (16, 64)
+    max_new_range: Tuple[int, int] = (8, 32)
+    vocab_size: int = 50304
+    seed: int = 0
+    sampling: Optional[SamplingParams] = None
+
+
+def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
+    """[(arrival_offset_s, Request), ...] sorted by arrival. Poisson
+    arrivals (exponential gaps at ``rate_rps``), uniform prompt/output
+    lengths, uniform random token ids — deterministic per seed."""
+    rng = np.random.default_rng(spec.seed)
+    gaps = rng.exponential(1.0 / max(spec.rate_rps, 1e-9),
+                           spec.num_requests)
+    arrivals = np.cumsum(gaps)
+    arrivals[0] = 0.0                       # first request at t=0
+    out = []
+    lo_p, hi_p = spec.prompt_len_range
+    lo_n, hi_n = spec.max_new_range
+    for i in range(spec.num_requests):
+        plen = int(rng.integers(lo_p, hi_p + 1))
+        prompt = rng.integers(0, spec.vocab_size, (plen,)).astype(np.int32)
+        out.append((float(arrivals[i]), Request(
+            prompt,
+            max_new_tokens=int(rng.integers(lo_n, hi_n + 1)),
+            sampling=spec.sampling or SamplingParams())))
+    return out
+
+
+def run_open_loop(engine, spec: LoadSpec, time_scale: float = 1.0,
+                  clock=time.perf_counter) -> dict:
+    """Drive ``engine`` through the schedule; returns
+    ``engine.metrics_summary()`` augmented with offered load."""
+    schedule = build_requests(spec)
+    t0 = clock()
+    i = 0
+    while i < len(schedule) or engine.scheduler.has_work:
+        now = clock() - t0
+        while i < len(schedule) and \
+                schedule[i][0] * time_scale <= now:
+            engine.submit(schedule[i][1])
+            i += 1
+        if engine.scheduler.has_work:
+            engine.step()
+        elif i < len(schedule):
+            # idle gap before the next arrival: sleep the remainder
+            wait = schedule[i][0] * time_scale - (clock() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+    summary = engine.metrics_summary()
+    summary["offered_rate_rps"] = spec.rate_rps / max(time_scale, 1e-9)
+    summary["num_requests"] = spec.num_requests
+    return summary

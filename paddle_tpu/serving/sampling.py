@@ -1,0 +1,70 @@
+"""Batched next-token sampling with per-slot parameters.
+
+One decode program serves every request in the batch even when requests
+mix greedy / temperature / top-k / top-p settings: the parameters are
+``[B]`` device arrays (arguments of the compiled step), and the math is
+fully vectorized — never a per-request branch, never a recompile when a
+slot's sampling config changes.
+
+Conventions (matching ``models/generation.py``'s single-request
+``_sample``): ``temperature <= 0`` means greedy (argmax); ``top_k <= 0``
+disables the top-k filter; ``top_p >= 1`` disables nucleus filtering.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "sample_tokens"]
+
+_NEG = -1e30
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode strategy. Defaults to greedy."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+
+def sample_tokens(logits, key, temperature, top_k, top_p):
+    """Next token per row from ``[B, V]`` logits.
+
+    ``temperature``/``top_p`` are ``[B]`` f32, ``top_k`` ``[B]`` int32.
+    Rows with ``temperature <= 0`` take the argmax (their filtered-
+    sampling lane still computes but is discarded by the final select —
+    the price of one branch-free program). Returns ``[B]`` int32.
+    """
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    lg = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: keep values >= the k-th largest; k<=0 means keep all
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]                      # descending
+    k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    kth = jnp.take_along_axis(srt, (k_eff - 1).astype(jnp.int32)[:, None],
+                              axis=-1)
+    lg = jnp.where(lg < kth, _NEG, lg)
+    # top-p over the k-filtered distribution: keep the smallest prefix of
+    # the sorted probs with cumulative mass >= top_p
+    srt2 = jnp.sort(lg, axis=-1)[:, ::-1]
+    cum = jnp.cumsum(jax.nn.softmax(srt2, axis=-1), axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(
+        srt2, jnp.clip(cutoff_idx, 0, V - 1)[:, None], axis=-1)
+    lg = jnp.where(lg < cutoff, _NEG, lg)
+
+    sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

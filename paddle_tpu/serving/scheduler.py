@@ -1,0 +1,321 @@
+"""Continuous-batching scheduler: iteration-level admission into fixed
+batch slots.
+
+The Orca (OSDI '22) scheduling model on static XLA shapes: scheduling
+decisions happen **between** decode steps, never inside a compiled
+program —
+
+- a FIFO request queue feeds ``max_batch_slots`` fixed slots; a request
+  is admitted the step a slot AND enough KV pages free up, and its slot
+  is released the step it finishes (no waiting for a batch to drain —
+  the throughput lever continuous batching exists for);
+- admitted requests are **prefilled in bucketed groups**: the prompt
+  rounds up to a ``(batch, prefill_len)`` bucket from the
+  :class:`BucketTable`, so the number of distinct prefill executables is
+  bounded by the table, not by traffic (decode is always the ONE
+  full-slot-batch program — admission/eviction just flips the active
+  mask and block tables, which are arguments);
+- when the page pool runs dry mid-decode, the newest-admitted request is
+  **preempted** (vLLM's recompute policy): its pages are freed, its
+  prompt + tokens-so-far go back to the FRONT of the queue, and it
+  re-prefills later — for greedy decoding the continuation is
+  token-identical.
+
+All of this is host-side bookkeeping over ints; device state never
+changes shape.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .kv_cache import PagedKVCache
+from .sampling import SamplingParams
+
+__all__ = ["Request", "RequestState", "BucketTable", "Scheduler",
+           "AdmissionGroup"]
+
+_request_ids = itertools.count()
+
+
+def _reset_request_ids() -> None:
+    global _request_ids
+    _request_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request.
+
+    ``on_token(request, token_id, text)`` streams every generated token
+    the decode step it is produced (``text`` is None unless the engine
+    has a detokenizer). ``eos_token_id`` ends the stream early; the eos
+    token itself is reported and included in the output.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    on_token: Optional[Callable] = None
+    request_id: int = field(default_factory=lambda: next(_request_ids))
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class RequestState:
+    """Scheduler-internal lifecycle record for one request."""
+
+    def __init__(self, request: Request, now: float):
+        self.request = request
+        self.prompt_len = int(request.prompt.size)
+        self.generated: List[int] = []
+        self.slot: Optional[int] = None
+        self.submitted_t = now
+        self.admitted_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.preemptions = 0
+        self.finished = False
+
+    @property
+    def seq_len(self) -> int:
+        """Positions currently held in the KV cache (prompt + generated
+        tokens whose K/V have been written)."""
+        return self.prompt_len + len(self.generated)
+
+    def effective_prompt(self) -> np.ndarray:
+        """What a (re-)prefill must process: the original prompt plus any
+        tokens generated before a preemption."""
+        if not self.generated:
+            return self.request.prompt
+        return np.concatenate([
+            self.request.prompt,
+            np.asarray(self.generated, np.int32)])
+
+    def remaining_new_tokens(self) -> int:
+        return self.request.max_new_tokens - len(self.generated)
+
+    def is_done(self) -> bool:
+        if len(self.generated) >= self.request.max_new_tokens:
+            return True
+        eos = self.request.eos_token_id
+        return eos is not None and bool(self.generated) \
+            and self.generated[-1] == eos
+
+    def max_total_len(self) -> int:
+        return self.prompt_len + self.request.max_new_tokens
+
+
+class BucketTable:
+    """The compile-count budget: every prefill runs at a
+    ``(batch_bucket, len_bucket)`` shape from this table, so the set of
+    prefill executables is bounded by ``len(batch) * len(lens)``
+    regardless of traffic mix. Decode is excluded — it has exactly one
+    shape (the full slot batch)."""
+
+    def __init__(self, prefill_lens: Sequence[int],
+                 batch_sizes: Sequence[int]):
+        if not prefill_lens or not batch_sizes:
+            raise ValueError("bucket table needs >= 1 len and batch bucket")
+        self.prefill_lens = tuple(sorted(set(int(x) for x in prefill_lens)))
+        self.batch_sizes = tuple(sorted(set(int(x) for x in batch_sizes)))
+
+    @property
+    def max_prefill_len(self) -> int:
+        return self.prefill_lens[-1]
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_sizes[-1]
+
+    def len_bucket(self, n: int) -> int:
+        for b in self.prefill_lens:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds the largest "
+                         f"prefill bucket ({self.max_prefill_len})")
+
+    def batch_bucket(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    def signatures(self) -> List[Tuple[int, int]]:
+        return [(b, s) for s in self.prefill_lens for b in self.batch_sizes]
+
+
+@dataclass
+class AdmissionGroup:
+    """One bucketed prefill dispatch: ``states`` (already holding slots
+    and pages) padded up to ``batch_bucket`` rows at ``len_bucket``
+    columns by the engine."""
+
+    len_bucket: int
+    batch_bucket: int
+    states: List[RequestState]
+
+
+class Scheduler:
+    """FIFO queue + slot/page admission control (host-side only)."""
+
+    def __init__(self, cache: PagedKVCache, buckets: BucketTable,
+                 max_queue: int = 1024, clock=time.perf_counter,
+                 max_seq_len: Optional[int] = None):
+        self.cache = cache
+        self.buckets = buckets
+        # the admission limit is the CONFIGURED context window (position
+        # embeddings!), not the cache's block-rounded physical capacity
+        # which may be up to block_size-1 positions larger
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else cache.max_context_len)
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self.waiting: List[RequestState] = []
+        self.slots: List[Optional[RequestState]] = \
+            [None] * cache.max_slots
+        self.stats = {"submitted": 0, "completed": 0, "preemptions": 0,
+                      "admitted": 0}
+
+    # -- queue --------------------------------------------------------------
+    def submit(self, request: Request) -> RequestState:
+        if len(self.waiting) >= self.max_queue:
+            raise RuntimeError(f"request queue full ({self.max_queue})")
+        if request.prompt.size + request.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({request.prompt.size}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the per-slot context "
+                f"capacity ({self.max_seq_len})")
+        # a request that could never hold its pages even ALONE in the pool
+        # would stall admission forever (alloc fails with everything free,
+        # nothing to preempt) — reject it at submit, not as a livelock
+        from .kv_cache import blocks_needed
+        alloc = self.cache.allocator
+        need = blocks_needed(request.prompt.size + request.max_new_tokens,
+                             self.cache.block_size)
+        if need > alloc.num_pages - alloc.reserved:
+            raise ValueError(
+                f"request needs {need} KV pages at full length but the "
+                f"pool only holds {alloc.num_pages - alloc.reserved} — "
+                "raise ServingConfig.num_pages or shrink the request")
+        # the bucket table must be able to re-prefill this request even
+        # after a worst-case preemption (prompt + all generated tokens)
+        self.buckets.len_bucket(
+            request.prompt.size + request.max_new_tokens - 1)
+        st = RequestState(request, self.clock())
+        self.waiting.append(st)
+        self.stats["submitted"] += 1
+        return st
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def active(self) -> List[Tuple[int, RequestState]]:
+        return [(i, st) for i, st in enumerate(self.slots)
+                if st is not None]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            st is not None for st in self.slots)
+
+    # -- admission ----------------------------------------------------------
+    def plan_admissions(self) -> List[AdmissionGroup]:
+        """Admit as many waiting requests as slots + pages allow, FIFO,
+        and group them into bucketed prefill dispatches. Allocation is
+        done here (slot assigned, pages for the effective prompt), so a
+        returned group is guaranteed runnable."""
+        admitted: List[Tuple[int, RequestState]] = []
+        free_slots = [i for i, st in enumerate(self.slots) if st is None]
+        while self.waiting and free_slots:
+            st = self.waiting[0]
+            slot = free_slots[0]
+            if not self.cache.alloc_slot(slot, st.effective_prompt().size):
+                break                      # page pool dry: FIFO blocks
+            self.waiting.pop(0)
+            free_slots.pop(0)
+            st.slot = slot
+            st.admitted_t = self.clock()
+            self.slots[slot] = st
+            admitted.append((slot, st))
+            self.stats["admitted"] += 1
+        groups: List[AdmissionGroup] = []
+        by_len = {}
+        for slot, st in admitted:
+            lb = self.buckets.len_bucket(st.effective_prompt().size)
+            by_len.setdefault(lb, []).append(st)
+        for lb in sorted(by_len):
+            sts = by_len[lb]
+            mb = self.buckets.max_batch
+            for i in range(0, len(sts), mb):
+                chunk = sts[i:i + mb]
+                groups.append(AdmissionGroup(
+                    lb, self.buckets.batch_bucket(len(chunk)), chunk))
+        return groups
+
+    # -- decode-time growth / preemption ------------------------------------
+    def ensure_decode_capacity(self) -> List[RequestState]:
+        """Before a decode step, make sure every active slot has a page
+        for the position it is about to write (``seq_len``). On a dry
+        pool, preempt newest-admitted requests (recompute policy) until
+        the older ones fit. Returns the preempted states (already
+        requeued at the queue front)."""
+        preempted: List[RequestState] = []
+        # oldest-first: earlier-admitted requests keep their pages
+        order = sorted(self.active(), key=lambda p: p[1].admitted_t)
+        for slot, st in order:
+            if self.slots[slot] is not st:
+                continue                       # preempted below, skip
+            # this decode step writes position seq_len-1 (the newest
+            # generated token's K/V) -> the slot must cover seq_len
+            # positions
+            while not self.cache.extend_slot(slot, st.seq_len):
+                victim = self._newest_active(exclude=st)
+                if victim is None:
+                    raise RuntimeError(
+                        "KV page pool too small for a single request: "
+                        f"{st.seq_len} tokens need more pages than "
+                        "the pool holds — raise num_pages or shrink "
+                        "max_new_tokens")
+                self._preempt(victim)
+                preempted.append(victim)
+        return preempted
+
+    def _newest_active(self, exclude: RequestState) \
+            -> Optional[RequestState]:
+        cands = [st for _, st in self.active() if st is not exclude]
+        if not cands:
+            return None
+        return max(cands, key=lambda s: s.admitted_t)
+
+    def _preempt(self, st: RequestState) -> None:
+        assert st.slot is not None
+        self.cache.free_slot(st.slot)
+        self.slots[st.slot] = None
+        st.slot = None
+        st.admitted_t = None
+        st.preemptions += 1
+        self.stats["preemptions"] += 1
+        self.waiting.insert(0, st)             # reclaims FIFO priority
+
+    # -- completion ---------------------------------------------------------
+    def finish(self, st: RequestState) -> None:
+        assert st.slot is not None
+        self.cache.free_slot(st.slot)
+        self.slots[st.slot] = None
+        st.slot = None
+        st.finished = True
+        st.finished_t = self.clock()
+        self.stats["completed"] += 1

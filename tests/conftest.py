@@ -40,6 +40,11 @@ def pytest_configure(config):
         "chaos: drives the paddle_tpu.testing.chaos fault injector "
         "(injector state is reset around every test by the autouse "
         "_chaos_isolation fixture)")
+    config.addinivalue_line(
+        "markers",
+        "serve: exercises the paddle_tpu.serving engine (engine global "
+        "state — live engines, request-id counter — is reset around "
+        "every test by the autouse _serving_isolation fixture)")
 
 
 @pytest.fixture(autouse=True)
@@ -51,6 +56,18 @@ def _chaos_isolation():
     chaos.reset()
     yield
     chaos.reset()
+
+
+@pytest.fixture(autouse=True)
+def _serving_isolation():
+    """Serving-engine global state (live engines, the request-id
+    counter, the scan-fallback warn-once set) must not leak between
+    tests. Only touches paddle_tpu.serving when a test imported it."""
+    import sys
+    yield
+    if "paddle_tpu.serving" in sys.modules:
+        import paddle_tpu.serving as serving
+        serving.reset()
 
 
 @pytest.fixture(autouse=True)

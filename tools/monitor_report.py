@@ -13,6 +13,10 @@ stream — prints:
 - with ``--memory``: per-program HBM budget table
   (``train_step_program_*`` gauges) + the live-buffer census
   (``live_buffer_bytes`` by category, from monitor.memory);
+- with ``--serve``: the serving engine's per-request latency histograms
+  (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
+  occupancy, queue-depth/slot/page gauges and serving program HBM
+  budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
 - everything else (counters/gauges) as a flat table.
 
 ``--flight`` switches input format entirely: the argument is a crash
@@ -23,7 +27,7 @@ preemptions, chaos fires — docs/FAULT_TOLERANCE.md), the event log and
 the last-N step records.
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
 
 Exit code: 0 on success (including an empty report), 2 on usage/read
@@ -109,6 +113,75 @@ def _memory_section(latest, used) -> List[str]:
     return out
 
 
+def _hist_pct(row: dict, q: float) -> Optional[float]:
+    """Approximate quantile from a cumulative-`le` histogram sample: the
+    smallest bucket upper bound covering fraction ``q`` of observations
+    (None when empty or when the quantile falls past the last bucket)."""
+    count = row.get("count") or 0
+    if not count:
+        return None
+    target = q * count
+    for le, cum in row.get("buckets") or []:
+        if cum >= target:
+            return float(le)
+    return None
+
+
+def _serve_section(latest, used) -> List[str]:
+    """--serve: per-request latency histograms + queue/occupancy gauges
+    from the serving engine's registry stream (docs/SERVING.md)."""
+    lat_rows = []
+    for name in ("serve_ttft_seconds", "serve_tpot_seconds",
+                 "serve_e2e_seconds", "serve_decode_step_seconds",
+                 "serve_prefill_seconds"):
+        for key, row in sorted(latest.items()):
+            if key[0] != name or row.get("type") != "histogram":
+                continue
+            used.add(key)
+            n = int(row.get("count") or 0)
+            mean = (row["sum"] / n * 1e3) if n else 0.0
+            p50, p99 = _hist_pct(row, 0.50), _hist_pct(row, 0.99)
+            fmt = lambda v: f"<= {v * 1e3:,.1f}" if v is not None else "-"
+            lat_rows.append([name[len("serve_"):], _fmt_labels(key[1]),
+                             str(n), f"{mean:,.2f}", fmt(p50), fmt(p99)])
+    out = _table("Serving latency (per-request histograms)",
+                 ["series", "labels", "count", "mean ms", "~p50 ms",
+                  "~p99 ms"], lat_rows)
+    occ_rows, g_rows, c_rows, prog_rows = [], [], [], []
+    for key in sorted(latest):
+        name, labels = key
+        if not name.startswith("serve_") or key in used:
+            continue
+        row = latest[key]
+        used.add(key)
+        if name == "serve_decode_occupancy":
+            n = int(row.get("count") or 0)
+            mean = row["sum"] / n if n else 0.0
+            occ_rows.append([str(n), f"{mean:,.2f}",
+                             f"{_hist_pct(row, 1.0) or 0:g}"])
+        elif name == "serve_program_peak_hbm_bytes":
+            prog_rows.append([dict(labels).get("kind", "-"),
+                              _fmt_bytes(row.get("value", 0.0))])
+        elif row.get("type") == "gauge":
+            g_rows.append([name, _fmt_labels(labels),
+                           f"{row.get('value', 0):g}"])
+        elif row.get("type") == "counter":
+            c_rows.append([name, _fmt_labels(labels),
+                           f"{row.get('value', 0):g}"])
+    out += _table("Decode batching", ["dispatches", "mean occupancy",
+                                      "max bucket"], occ_rows)
+    out += _table("Queue / slots / pages (gauges)",
+                  ["gauge", "labels", "value"], g_rows)
+    out += _table("Serving counters", ["counter", "labels", "value"],
+                  c_rows)
+    out += _table("Serving program HBM budgets",
+                  ["kind", "peak HBM est."], prog_rows)
+    if not out:
+        out = ["== Serving ==", "(no serve_* metrics in this dump — "
+               "run bench.py --serve or a ServingEngine first)", ""]
+    return out
+
+
 # recovery-timeline event names (kept in sync with
 # paddle_tpu.monitor.flight_recorder.RECOVERY_EVENTS; inlined so the
 # report renders dumps without importing the framework)
@@ -182,24 +255,31 @@ def render_flight(doc: dict, last: int = 10) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
-def render(rows: List[dict], top: int = 10, memory: bool = False) -> str:
+def render(rows: List[dict], top: int = 10, memory: bool = False,
+           serve: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
+
+    # -- serving (--serve) first: its histograms would otherwise be
+    # swallowed by the generic slowest-events table ----------------------
+    serve_out: List[str] = _serve_section(latest, used) if serve else []
 
     # -- slowest timing histograms ----------------------------------------
     timings = []
     for key, row in latest.items():
         name, labels = key
+        if key in used:
+            continue                 # --serve already rendered these
         if row.get("type") == "histogram" and row.get("count"):
             timings.append((row.get("sum", 0.0), name, labels, row))
             used.add(key)
-    timings.sort(reverse=True)
+    timings.sort(reverse=True, key=lambda t: t[0])
     t_rows = [[name, _fmt_labels(labels), str(int(r["count"])),
                f"{s:,.3f}", f"{s / r['count'] * 1e3:,.3f}"]
               for s, name, labels, r in timings[:top]]
-    out = _table(f"Slowest events (top {top} by total time)",
-                 ["event", "labels", "count", "total s", "mean ms"],
-                 t_rows)
+    out = serve_out + _table(
+        f"Slowest events (top {top} by total time)",
+        ["event", "labels", "count", "total s", "mean ms"], t_rows)
     if len(timings) > top:
         out.append(f"  ... {len(timings) - top} more timing series "
                    "(raise --top)\n")
@@ -287,6 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     memory = "--memory" in argv
     if memory:
         argv.remove("--memory")
+    serve = "--serve" in argv
+    if serve:
+        argv.remove("--serve")
     if len(argv) != 1:
         print(__doc__, file=sys.stderr)
         return 2
@@ -307,7 +390,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
-    print(render(rows, top=top, memory=memory), end="")
+    print(render(rows, top=top, memory=memory, serve=serve), end="")
     return 0
 
 
