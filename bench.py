@@ -624,6 +624,168 @@ def bench_serve(quick: bool = False) -> list:
     ]
 
 
+def bench_kernels(quick: bool = False) -> list:
+    """``--kernels``: kernel-level microbench of the ops.pallas layer
+    (docs/PERF_KERNELS.md) — the BENCH_kernels record. Each kernel is
+    timed at the DISPATCH level, so the numbers measure whatever path
+    production would serve here: the Pallas body on TPU, the XLA
+    fallback elsewhere (``kernel_live`` on each line says which; on the
+    CPU tunnel the record is an XLA-fallback bandwidth floor the TPU
+    run then gates against as a pure improvement). ``kernel_*_ms``
+    gates lower-is-better, ``kernel_*_gbps`` (bytes the op must move /
+    wall time — the bandwidth-bound figure of merit) higher-is-better.
+
+    ``--quick``: tiny shapes, smoke only, no record."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import chunked_ce as cce
+    from paddle_tpu.ops import pallas as pallas_ops
+
+    rng = np.random.RandomState(0)
+    lines = []
+
+    def gbps(nbytes, ms):
+        return nbytes / (ms * 1e-3) / 1e9
+
+    # -- fused chunked CE: fwd+bwd over [N, V] logits ----------------------
+    N, V = (256, 2048) if quick else (2048, 32768)
+    chunk = min(V, 8192)
+    logits = jnp.asarray(rng.randn(N, V).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+    live = float(pallas_ops.kernel_enabled("chunked_ce", note=False))
+    step = jax.jit(jax.value_and_grad(
+        lambda l: cce.hard_nll(l, labels, chunk=chunk).sum()))
+    step(logits)[0].block_until_ready()          # compile outside the clock
+    ms = steady_ms(lambda: step(logits)[0], iters=2 if quick else 5)
+    # bytes the op must move: logits read fwd + read bwd + dlogits write
+    by = 3 * N * V * 4
+    log(f"kernels[ce]: [{N}, {V}] fwd+bwd {ms:.1f} ms, "
+        f"{gbps(by, ms):.1f} GB/s (live={live:.0f})")
+    lines += [
+        metric_line("kernel_chunked_ce_ms", ms, "ms", vs_baseline=1.0,
+                    kernel_live=live),
+        metric_line("kernel_chunked_ce_gbps", gbps(by, ms), "GB/s",
+                    vs_baseline=1.0, kernel_live=live),
+    ]
+
+    # -- paged flash-decode: one decode step over the paged KV pool --------
+    B, H, D, bs, MB = (2, 4, 16, 4, 4) if quick else (8, 16, 64, 16, 32)
+    P = B * MB + 1                               # page 0 = scratch
+    kp = jnp.asarray(rng.randn(P, bs, H, D).astype(np.float32))
+    vp = jnp.asarray(rng.randn(P, bs, H, D).astype(np.float32))
+    tbl = jnp.asarray(
+        1 + np.arange(B * MB, dtype=np.int32).reshape(B, MB))
+    pos = jnp.full((B,), MB * bs - 1, jnp.int32)  # slots fully grown
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    scale = 1.0 / float(np.sqrt(D))
+    live = float(pallas_ops.kernel_enabled("paged_decode", note=False))
+    if live:
+        from paddle_tpu.ops.pallas.paged_decode import paged_decode_attention
+        fn = jax.jit(lambda *a: paged_decode_attention(*a, scale=scale))
+    else:
+        from paddle_tpu.serving.kv_cache import gather_pages
+
+        def _fallback(q_, kp_, vp_, tbl_, pos_):
+            gk, gv = gather_pages(kp_, tbl_), gather_pages(vp_, tbl_)
+            cols = jnp.arange(gk.shape[1])
+            mask = jnp.where(cols[None, :] <= pos_[:, None], 0.0, -1e30)
+            s = (jnp.einsum("bhd,bkhd->bhk", q_, gk) * scale
+                 + mask[:, None, :])
+            pr = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+            return jnp.einsum("bhk,bkhd->bhd", pr, gv).astype(q_.dtype)
+
+        fn = jax.jit(_fallback)
+    fn(q, kp, vp, tbl, pos).block_until_ready()
+    ms = steady_ms(lambda: fn(q, kp, vp, tbl, pos).ravel()[0],
+                   iters=5 if quick else 20)
+    # bytes the step must move: every live K/V page read once
+    by = 2 * B * MB * bs * H * D * 4
+    log(f"kernels[paged_decode]: B={B} ctx={MB * bs} H={H} D={D} "
+        f"{ms:.2f} ms, {gbps(by, ms):.1f} GB/s (live={live:.0f})")
+    lines += [
+        metric_line("kernel_paged_decode_ms", ms, "ms", vs_baseline=1.0,
+                    kernel_live=live),
+        metric_line("kernel_paged_decode_gbps", gbps(by, ms), "GB/s",
+                    vs_baseline=1.0, kernel_live=live),
+    ]
+
+    # -- int8 quantized matmul vs the f32 gemm -----------------------------
+    M, K, Nn = (64, 256, 256) if quick else (512, 2048, 2048)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray((rng.randn(K, Nn) * 0.05).astype(np.float32))
+    from paddle_tpu.ops.pallas.quant_matmul import (int8_linear,
+                                                    quantize_per_channel)
+    w_q, w_s = quantize_per_channel(w)
+    live = float(pallas_ops.kernel_enabled("int8_matmul", note=False))
+    if live:
+        fn8 = jax.jit(lambda a: int8_linear(a, w_q, w_s))
+    else:
+        # the pre-kernel slim weight-only path: dequantize into the gemm
+        fn8 = jax.jit(lambda a: jnp.matmul(
+            a, w_q.astype(a.dtype) * w_s.astype(a.dtype)))
+    fnf = jax.jit(lambda a: jnp.matmul(a, w))
+    fn8(x).block_until_ready()
+    fnf(x).block_until_ready()
+    ms8 = steady_ms(lambda: fn8(x).ravel()[0], iters=5 if quick else 20)
+    msf = steady_ms(lambda: fnf(x).ravel()[0], iters=5 if quick else 20)
+    # weight-traffic win: int8 weights + int8 acts + f32 out
+    by = M * K + K * Nn + M * Nn * 4
+    log(f"kernels[int8_matmul]: [{M}x{K}]@[{K}x{Nn}] int8 {ms8:.2f} ms "
+        f"vs f32 {msf:.2f} ms ({msf / ms8:.2f}x, live={live:.0f})")
+    lines += [
+        metric_line("kernel_int8_matmul_ms", ms8, "ms", vs_baseline=1.0,
+                    kernel_live=live, f32_ms=msf),
+        metric_line("kernel_int8_matmul_gbps", gbps(by, ms8), "GB/s",
+                    vs_baseline=1.0, kernel_live=live),
+    ]
+    return lines
+
+
+def write_gated_record(rec_name: str, metrics: list) -> None:
+    """Write/self-gate a standalone bench record (BENCH_serve.json,
+    BENCH_kernels.json): gate the fresh metrics against the existing
+    record, park it at ``.prev`` — EVEN when the gate errored (corrupt
+    record, import error): a regressed or broken run must never silently
+    become the next baseline — then write the fresh record."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    rec = os.path.join(here, rec_name)
+    tag = rec_name.rsplit(".", 1)[0]
+    try:
+        sys.path.insert(0, os.path.join(here, "tools"))
+        import check_bench
+        if os.path.exists(rec):
+            with open(rec) as f:
+                old = check_bench._metric_list(json.load(f))
+            for p in check_bench.compare_common(old, metrics):
+                log(f"{tag} GATE: " + p)
+    except Exception as e:
+        log(f"{tag} gate skipped: {e!r}")
+    try:
+        if os.path.exists(rec):
+            os.replace(rec, rec + ".prev")
+    except OSError as e:
+        log(f"could not park previous record: {e!r}")
+    with open(rec, "w") as f:
+        json.dump(metrics, f, indent=1)
+    log(f"{tag}: record written to {rec} "
+        f"(gate: python tools/check_bench.py {rec_name}.prev {rec_name})")
+
+
+def run_kernels_mode(quick: bool) -> None:
+    """--kernels: emit ONLY the kernel metric lines (one JSON per line)
+    and write/self-gate the BENCH_kernels.json record (full runs),
+    parking the previous record at .prev — same contract as --serve."""
+    metrics = bench_kernels(quick=quick)
+    for m in metrics:
+        print(json.dumps(m), flush=True)
+    if quick:
+        log("kernels: --quick run, BENCH_kernels.json not written")
+        return
+    write_gated_record("BENCH_kernels.json", metrics)
+
+
 def run_serve_mode(quick: bool) -> None:
     """--serve: emit ONLY the serving metric lines (one JSON per line),
     write/self-gate the BENCH_serve.json record (full runs), and dump
@@ -645,30 +807,7 @@ def run_serve_mode(quick: bool) -> None:
     if quick:
         log("serve: --quick run, BENCH_serve.json not written")
         return
-    rec = os.path.join(here, "BENCH_serve.json")
-    try:
-        sys.path.insert(0, os.path.join(here, "tools"))
-        import check_bench
-        if os.path.exists(rec):
-            with open(rec) as f:
-                old = check_bench._metric_list(json.load(f))
-            for p in check_bench.compare_common(old, metrics):
-                log("BENCH_serve GATE: " + p)
-    except Exception as e:
-        log(f"serve gate skipped: {e!r}")
-    # the previous record survives as .prev EVEN when the gate above
-    # failed (corrupt record, import error): a regressed or broken run
-    # must never silently become the next baseline
-    try:
-        if os.path.exists(rec):
-            os.replace(rec, rec + ".prev")
-    except OSError as e:
-        log(f"could not park previous record: {e!r}")
-    with open(rec, "w") as f:
-        json.dump(metrics, f, indent=1)
-    log(f"serve: record written to {rec} "
-        "(gate: python tools/check_bench.py BENCH_serve.json.prev "
-        "BENCH_serve.json)")
+    write_gated_record("BENCH_serve.json", metrics)
 
 
 def main() -> None:
@@ -709,6 +848,10 @@ def main() -> None:
         # serving bench is its own record (BENCH_serve): the training
         # metric lines and the last-line-headline contract stay untouched
         run_serve_mode(quick=not full)
+        return
+    if "--kernels" in sys.argv:
+        # kernel microbench is its own record too (BENCH_kernels)
+        run_kernels_mode(quick=not full)
         return
     metrics = []
 

@@ -212,6 +212,44 @@ define_flag("chaos_seed", 0,
             "Seed for probability-based chaos sites: the same "
             "(seed, site, occurrence) triple always makes the same "
             "fire/no-fire decision, so chaos runs replay exactly.")
+define_flag("pallas_ce", True,
+            "Serve the streamed (chunked) hard-label cross-entropy with "
+            "the fused Pallas kernel (ops.pallas.chunked_ce): online f32 "
+            "logsumexp forward + one-pass dlogits backward, one VMEM-"
+            "resident [rows, chunk] tile per grid step. Off = the pure-XLA "
+            "fori_loop streaming path (nn.chunked_ce, bit-identical to "
+            "the pre-kernel implementation). Soft labels and the dense "
+            "mp-sharded path never use the kernel.")
+define_flag("pallas_paged_decode", True,
+            "Serve paged-KV decode attention (serving, S==1) with the "
+            "Pallas flash-decode kernel (ops.pallas.paged_decode): K/V "
+            "block-table pages are read in place via scalar-prefetch "
+            "indexing — the [B, MB*bs, H, D] gathered context never "
+            "materializes in HBM. Off = the XLA gather_pages + masked "
+            "SDPA composition (bit-identical to the pre-kernel path).")
+define_flag("pallas_int8", True,
+            "Serve slim.QuantizedLinear matmuls with the Pallas int8 "
+            "kernel (ops.pallas.quant_matmul): per-output-channel-scaled "
+            "int8 x int8 -> int32 with a dequantize epilogue; weights "
+            "stay int8 through the gemm (weight-only mode quantizes the "
+            "activations dynamically per tensor). Off = the pre-kernel "
+            "XLA paths (weight-only: dequantize-to-float matmul; static "
+            "act_scale: XLA int8 dot).")
+define_flag("amp_int8_matmul", False,
+            "EXPERIMENTAL: under an active amp.auto_cast region, run "
+            "eligible nn.functional.linear matmuls through the Pallas "
+            "int8 kernel with dynamic per-tensor activation/per-channel "
+            "weight quantization and a straight-through dense backward "
+            "(gradients flow to the UNquantized operands). Requires "
+            "FLAGS_pallas_int8; off by default — int8 training is a "
+            "numerics experiment, not the production AMP path.")
+define_flag("pallas_interpret", False,
+            "Run the ops.pallas kernel layer on non-TPU backends through "
+            "the Pallas interpreter instead of falling back to XLA. "
+            "SLOW — for kernel parity tests on CPU (the `pallas` pytest "
+            "marker flips it); production CPU dispatch keeps the XLA "
+            "fallbacks. flash_attention keeps its own shape gate in "
+            "ops.attention and ignores this flag.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -167,8 +167,16 @@ class ParallelCrossEntropy(Layer):
             tgt = jnp.take_along_axis(z, ids[..., None], axis=-1)[..., 0]
             return (lse - tgt)[..., None]
 
+        # hard_nll's Pallas-vs-XLA dispatch resolves at trace time — the
+        # outcome rides the cache token so a kill-switch flip can never
+        # serve a stale cached trace (same rule as F.cross_entropy)
+        from ....ops import pallas as pallas_ops
+        ce_kernel = (use_chunked
+                     and pallas_ops.kernel_enabled("chunked_ce",
+                                                   note=False))
         return apply(_ce, logits, label, name="parallel_cross_entropy",
-                     _cache_token=("parallel_ce", use_chunked, chunk))
+                     _cache_token=("parallel_ce", use_chunked, chunk,
+                                   ce_kernel))
 
 
 def split(x, size, operation: str, axis: int = 0, gather_out: bool = True,

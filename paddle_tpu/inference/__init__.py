@@ -75,8 +75,14 @@ class Config:
         self._precision = PrecisionType.Bfloat16
 
     def enable_int8(self):
-        """Weight-only int8 quantization (analogue of TRT int8; needs a
-        live layer — a serialized executable is already frozen)."""
+        """int8 quantization (analogue of TRT int8; needs a live layer —
+        a serialized executable is already frozen). Weights are stored
+        per-channel int8 and — with ``FLAGS_pallas_int8`` (default) —
+        STAY int8 through the matmul: the Pallas int8 kernel quantizes
+        the activation stream per tensor and runs int8 x int8 -> int32
+        on the MXU (ops.pallas.quant_matmul). With the kill switch off
+        the pre-kernel behavior returns: weights dequantize into a
+        float gemm."""
         self._weight_quant = True
 
     def switch_ir_optim(self, flag: bool = True):

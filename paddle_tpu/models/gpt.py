@@ -242,7 +242,22 @@ class GPTAttention(Layer):
                 q, k, v, dropout_p=0.0, is_causal=True, training=False)
             return out, new_cache
 
+        # decode kernel dispatch resolved OUTSIDE the traced fn so the
+        # path choice is stable for any cached trace (kill switch:
+        # FLAGS_pallas_paged_decode -> the gather+SDPA composition)
+        from ..ops import pallas as pallas_ops
+        use_kernel = pallas_ops.kernel_enabled("paged_decode")
+
         def attend(q_, kpages, vpages, table, p):
+            if use_kernel:
+                # pages read in place via the block table: the gathered
+                # [B, MB*bs, H, D] context never materializes in HBM
+                from ..ops.pallas.paged_decode import paged_decode_attention
+                o = paged_decode_attention(
+                    q_[:, 0], kpages, vpages, table,
+                    p.astype(jnp.int32),
+                    scale=1.0 / math.sqrt(q_.shape[-1]))
+                return o[:, None]
             from ..ops.attention import sdpa_array
             gk = gather_pages(kpages, table)
             gv = gather_pages(vpages, table)

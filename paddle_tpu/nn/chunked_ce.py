@@ -201,12 +201,24 @@ _ce_soft.defvjp(_ce_soft_fwd, _ce_soft_bwd)
 def hard_nll(logits, labels, chunk: int = None):
     """Streamed per-position NLL. ``logits [..., V]``, ``labels [...]``
     integer class ids (caller maps ignore_index to a safe id and masks the
-    result). Returns f32 ``[...]`` losses."""
+    result). Returns f32 ``[...]`` losses.
+
+    Served by the fused Pallas kernel (ops.pallas.chunked_ce) when
+    ``FLAGS_pallas_ce`` is on and the backend can run it; the pure-XLA
+    fori_loop streaming op below is the kill-switch fallback (and the
+    only soft-label path)."""
     V = logits.shape[-1]
     lead = logits.shape[:-1]
     chunk = min(chunk or chunk_size_for(V), V)
-    loss = _ce_hard(int(chunk), logits.reshape((-1, V)),
-                    labels.reshape((-1,)).astype(jnp.int32))
+    from ..ops import pallas as pallas_ops
+    if pallas_ops.kernel_enabled("chunked_ce"):
+        from ..ops.pallas.chunked_ce import chunked_ce_loss
+        loss = chunked_ce_loss(logits.reshape((-1, V)),
+                               labels.reshape((-1,)).astype(jnp.int32),
+                               int(chunk))
+    else:
+        loss = _ce_hard(int(chunk), logits.reshape((-1, V)),
+                        labels.reshape((-1,)).astype(jnp.int32))
     return loss.reshape(lead)
 
 

@@ -219,15 +219,57 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 # Linear / conv — the MXU path
 # ---------------------------------------------------------------------------
 
+def _amp_int8_active(weight_t) -> bool:
+    """FLAGS_amp_int8_matmul routing gate for :func:`linear`: only under
+    an ACTIVE amp.auto_cast region, with the Pallas int8 kernel enabled
+    and a 2-D weight the kernel can tile. Resolved at dispatch time
+    (before any trace) and folded into the op-cache token, so a cached
+    f32 linear can never serve an int8 call or vice versa."""
+    from ..core.flags import get_flag
+    if not get_flag("amp_int8_matmul"):
+        return False
+    from ..amp.auto_cast import amp_state
+    st = amp_state()
+    if st is None or not st.enabled:
+        return False
+    from ..ops import pallas as pallas_ops
+    if not pallas_ops.kernel_enabled("int8_matmul"):
+        return False
+    if weight_t.ndim != 2:
+        return False
+    from ..ops.pallas.quant_matmul import matmul_shapes_supported
+    if not matmul_shapes_supported(int(weight_t.shape[0]),
+                                   int(weight_t.shape[1])):
+        pallas_ops.note_fallback("int8_matmul", "shape")
+        return False
+    return True
+
+
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W + b. Weight layout [in, out] (reference: nn/functional/common.py linear)."""
+    """y = x @ W + b. Weight layout [in, out] (reference: nn/functional/common.py linear).
+
+    Under ``FLAGS_amp_int8_matmul`` (+ an active autocast region) the
+    matmul runs through the Pallas int8 kernel with dynamically
+    quantized operands and a straight-through dense backward
+    (ops.pallas.quant_matmul.int8_amp_linear) — an experimental
+    throughput knob, off by default."""
     prec = matmul_precision()
+    w_t = _t(weight)
+    if _amp_int8_active(w_t):
+        from ..ops.pallas.quant_matmul import int8_amp_linear
+        if bias is None:
+            return apply(lambda a, w: int8_amp_linear(a, w),
+                         _t(x), w_t, name="linear",
+                         _cache_token=("linear_int8",))
+        return apply(lambda a, w, b: int8_amp_linear(a, w, b),
+                     _t(x), w_t, _t(bias), name="linear",
+                     _cache_token=("linear_int8",))
     if bias is None:
         return apply(lambda a, w: jnp.matmul(a, w, precision=prec),
-                     _t(x), _t(weight), name="linear",
+                     _t(x), w_t, name="linear",
                      _cache_token=("linear", str(prec)))
     return apply(lambda a, w, b: jnp.matmul(a, w, precision=prec) + b,
-                 _t(x), _t(weight), _t(bias), name="linear",
+                 _t(x), w_t, _t(bias), name="linear",
                  _cache_token=("linear", str(prec)))
 
 
@@ -1218,9 +1260,17 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             return _reduce(loss, reduction)
 
         args = [inp_t, _t(label)] + ([w] if w is not None else [])
+        # hard_nll resolves its Pallas-vs-XLA dispatch at trace time, so
+        # the outcome must ride the cache token: a kill-switch flip
+        # (FLAGS_pallas_ce / FLAGS_pallas_interpret) would otherwise keep
+        # serving the stale cached trace for already-seen signatures
+        from ..ops import pallas as pallas_ops
+        ce_kernel = (not soft_label
+                     and pallas_ops.kernel_enabled("chunked_ce",
+                                                   note=False))
         return apply(_ce_chunked, *args, name="cross_entropy",
                      _cache_token=("ce_chunked", reduction, ignore_index,
-                                   bool(soft_label), chunk))
+                                   bool(soft_label), chunk, ce_kernel))
 
     def _ce(logits, lab, *maybe_w):
         if use_softmax:

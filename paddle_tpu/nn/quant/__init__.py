@@ -11,13 +11,18 @@ straight-through gradient (stop_gradient residual), so the whole QAT
 graph stays jit-compilable; moving-average ranges live in buffers
 updated on the eager tape (and frozen under jit, matching the
 reference's is_test behavior). The deploy conversion lives in
-paddle_tpu.slim (QuantizedLinear with real int8 storage).
+paddle_tpu.slim (QuantizedLinear with real int8 storage), which runs
+the Pallas int8 x int8 matmul (ops.pallas.quant_matmul) on the grid
+:class:`PerChannelAbsMaxObserver` records — one symmetric-absmax scale
+rule shared by the QAT layers, the slim deploy pass and the kernel
+(docs/PARITY.md).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.tensor import Tensor, apply
 from ..layer import Layer
@@ -25,6 +30,7 @@ from ..layer import Layer
 __all__ = [
     "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",
     "FakeQuantMovingAverageAbsMax", "MovingAverageAbsMaxScale",
+    "PerChannelAbsMaxObserver",
     "QuantizedLinear", "QuantizedConv2D", "QuantizedConv2DTranspose",
     "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
     "FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
@@ -34,6 +40,53 @@ __all__ = [
 def _qdq(a, scale, qmax):
     q = jnp.clip(jnp.round(a / scale), -qmax, qmax) * scale
     return a + jax.lax.stop_gradient(q - a)     # straight-through grad
+
+
+class PerChannelAbsMaxObserver:
+    """Per-channel symmetric-absmax weight observer — THE scale rule of
+    the int8 stack (reference: the channel_wise_abs_max observer behind
+    slim's WeightQuantization). ``observe(w)`` records and returns the
+    per-channel scales ``absmax / (2^(bits-1) - 1)`` along
+    ``quant_axis``; ``quantize(w)`` returns the int8 weights + scales on
+    that grid. Host-side (numpy): observation happens at deploy
+    conversion, not inside traced programs. slim._channel_scales and
+    the Pallas kernel's ``quantize_per_channel`` both follow this rule —
+    tests pin they agree.
+    """
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1,
+                 eps: float = 1e-8):
+        self.quant_bits = int(quant_bits)
+        self.quant_axis = int(quant_axis)
+        self.eps = float(eps)
+        self.scales = None
+
+    @property
+    def qmax(self) -> float:
+        return 2.0 ** (self.quant_bits - 1) - 1
+
+    def observe(self, w) -> np.ndarray:
+        """Record per-channel scales of ``w`` (accumulating the running
+        absmax across calls, PTQ-style); returns the scales [channels]."""
+        w = np.asarray(w, np.float32)
+        red = tuple(i for i in range(w.ndim) if i != self.quant_axis)
+        absmax = np.abs(w).max(axis=red)
+        if self.scales is not None:
+            absmax = np.maximum(absmax, self.scales * self.qmax)
+        self.scales = np.maximum(absmax / self.qmax, self.eps) \
+            .astype(np.float32)
+        return self.scales
+
+    def quantize(self, w):
+        """(w_q int8, scales f32) on the observed grid (observes ``w``
+        first when no scales were recorded yet)."""
+        w = np.asarray(w, np.float32)
+        scales = self.scales if self.scales is not None else self.observe(w)
+        shape = [1] * w.ndim
+        shape[self.quant_axis] = -1
+        q = np.clip(np.round(w / scales.reshape(shape)),
+                    -self.qmax, self.qmax).astype(np.int8)
+        return q, scales
 
 
 class FakeQuantAbsMax(Layer):

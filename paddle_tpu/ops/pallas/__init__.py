@@ -1,0 +1,187 @@
+"""paddle_tpu.ops.pallas — the framework's hand-written TPU kernel layer.
+
+The TPU-native replacement for the reference's fused-CUDA operator
+library (PAPER.md: the operators/fused/ layer — fused_attention,
+softmax_with_cross_entropy, the slim int8 kernels). Every kernel here
+follows ONE dispatch convention:
+
+- a ``FLAGS_*`` kill switch (see :func:`kernels` for the flag matrix)
+  whose *off* position routes to an XLA fallback that is bit-compatible
+  with the pre-kernel implementation;
+- TPU-only by default: on other backends the kernel falls back to XLA
+  unless ``FLAGS_pallas_interpret`` forces the Pallas interpreter (the
+  ``pallas`` pytest marker does this — parity tests run the REAL kernel
+  bodies on CPU);
+- every fallback is counted: :func:`note_fallback` feeds the
+  ``pallas_fallback_total{kernel,reason}`` counter (monitor mode) and
+  the always-on :data:`PALLAS_STATS` dict, so ``tools/monitor_report.py
+  --kernels`` can show which kernels are live vs degraded;
+- a parity test in tests/test_pallas_kernels.py and a bench line in
+  ``bench.py --kernels`` (BENCH_kernels.json).
+
+Kernel inventory (docs/PERF_KERNELS.md):
+
+==================  ==========================  =========================
+kernel              flag                        XLA fallback
+==================  ==========================  =========================
+flash_attention     (shape gate in ops.         _sdpa_xla softmax
+                    attention, TPU-only)        composition
+chunked_ce          FLAGS_pallas_ce             nn.chunked_ce fori_loop
+                                                streaming path
+paged_decode        FLAGS_pallas_paged_decode   gather_pages + masked
+                                                SDPA (models/gpt.py)
+int8_matmul         FLAGS_pallas_int8           slim dequant-to-float /
+                                                XLA int8 dot
+==================  ==========================  =========================
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from ...core.flags import get_flag
+
+__all__ = [
+    "flash_attention", "chunked_ce_loss", "paged_decode_attention",
+    "int8_matmul", "int8_linear", "int8_amp_linear", "quantize_per_channel",
+    "kernels", "kernel_enabled", "note_fallback", "backend_supported",
+    "PALLAS_STATS", "reset_pallas_stats",
+]
+
+#: always-on fallback observability (monitor-independent, like
+#: nn.scan.SCAN_STATS): {(kernel, reason): count}
+PALLAS_STATS: Dict[tuple, int] = {}
+_STATS_LOCK = threading.Lock()
+
+#: the registry rows behind :func:`kernels` — name -> (flag, fallback
+#: description). flash_attention predates the flag convention: its gate
+#: is the shape/backend check in ops.attention._flash_supported.
+_REGISTRY = {
+    "flash_attention": (None, "XLA softmax composition (ops.attention."
+                              "_sdpa_xla); gate: _flash_supported"),
+    "chunked_ce": ("pallas_ce", "pure-XLA fori_loop streaming CE "
+                                "(nn.chunked_ce._ce_hard)"),
+    "paged_decode": ("pallas_paged_decode", "gather_pages + masked SDPA "
+                                            "(models/gpt.py)"),
+    "int8_matmul": ("pallas_int8", "weight dequantize-to-float matmul / "
+                                   "XLA int8 dot (slim.QuantizedLinear)"),
+}
+
+
+def reset_pallas_stats() -> None:
+    with _STATS_LOCK:
+        PALLAS_STATS.clear()
+
+
+def note_fallback(kernel: str, reason: str) -> None:
+    """Record that a kernel-eligible call degraded to its XLA fallback.
+
+    Bumps :data:`PALLAS_STATS` always and the
+    ``pallas_fallback_total{kernel,reason}`` registry counter in monitor
+    mode. Reasons: ``flag_off`` (kill switch), ``cpu_backend`` (non-TPU
+    without FLAGS_pallas_interpret), ``shape`` (unsupported geometry,
+    e.g. int8 gemm dims not 128-aligned).
+    """
+    with _STATS_LOCK:
+        PALLAS_STATS[(kernel, reason)] = \
+            PALLAS_STATS.get((kernel, reason), 0) + 1
+    from ...monitor import enabled as _mon_enabled
+    if _mon_enabled():
+        from ...monitor import get_registry
+        get_registry().counter(
+            "pallas_fallback_total",
+            "ops.pallas kernel calls that degraded to the XLA fallback, "
+            "by kernel and cause").inc(kernel=kernel, reason=reason)
+
+
+def backend_supported() -> bool:
+    """True when Pallas kernel bodies can execute here: a real TPU, or
+    any backend with the interpreter forced (``FLAGS_pallas_interpret``,
+    flipped by the ``pallas`` pytest marker)."""
+    import jax
+    return (jax.default_backend() == "tpu"
+            or bool(get_flag("pallas_interpret")))
+
+
+def kernel_enabled(name: str, note: bool = True) -> bool:
+    """One gate for every kernel call site: flag on AND backend capable.
+
+    ``note=False`` suppresses fallback accounting for probe-style calls
+    (``kernels()`` uses it to report status without inflating counters).
+    """
+    flag, _ = _REGISTRY[name]
+    if flag is not None and not get_flag(flag):
+        if note:
+            note_fallback(name, "flag_off")
+        return False
+    if not backend_supported():
+        if note:
+            note_fallback(name, "cpu_backend")
+        return False
+    return True
+
+
+def kernels() -> List[dict]:
+    """Enumerate the kernel layer: name, kill-switch flag (and its
+    current value), whether dispatch would serve the Pallas body right
+    now (``live``), the XLA fallback that serves otherwise, and the
+    fallback counts observed so far. Consumed by
+    ``tools/monitor_report.py --kernels`` and the registry tests."""
+    import jax
+    rows = []
+    for name, (flag, fallback) in _REGISTRY.items():
+        if name == "flash_attention":
+            live = jax.default_backend() == "tpu"
+        else:
+            live = kernel_enabled(name, note=False)
+        with _STATS_LOCK:
+            fb = {k[1]: v for k, v in PALLAS_STATS.items()
+                  if k[0] == name}
+        rows.append({
+            "kernel": name,
+            "flag": f"FLAGS_{flag}" if flag else None,
+            "flag_value": bool(get_flag(flag)) if flag else None,
+            "live": bool(live),
+            "fallback": fallback,
+            "fallbacks_seen": fb,
+        })
+    return rows
+
+
+# -- kernel entry points (lazy imports: pallas/jax.experimental loads
+# only when a kernel is actually called) ----------------------------------
+
+def flash_attention(*args, **kw):
+    from .flash_attention import flash_attention as _fa
+    return _fa(*args, **kw)
+
+
+def chunked_ce_loss(*args, **kw):
+    from .chunked_ce import chunked_ce_loss as _ce
+    return _ce(*args, **kw)
+
+
+def paged_decode_attention(*args, **kw):
+    from .paged_decode import paged_decode_attention as _pd
+    return _pd(*args, **kw)
+
+
+def int8_matmul(*args, **kw):
+    from .quant_matmul import int8_matmul as _mm
+    return _mm(*args, **kw)
+
+
+def int8_linear(*args, **kw):
+    from .quant_matmul import int8_linear as _ln
+    return _ln(*args, **kw)
+
+
+def int8_amp_linear(*args, **kw):
+    from .quant_matmul import int8_amp_linear as _al
+    return _al(*args, **kw)
+
+
+def quantize_per_channel(*args, **kw):
+    from .quant_matmul import quantize_per_channel as _q
+    return _q(*args, **kw)

@@ -1,0 +1,12 @@
+"""jax-version compatibility shims for the kernel modules.
+
+Imported for its side effect (``from . import _compat``) by every
+kernel module BEFORE it touches ``pltpu.CompilerParams`` — one place to
+track a jax rename instead of a per-kernel copy of the patch.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 names the dataclass TPUCompilerParams; same fields
+    pltpu.CompilerParams = pltpu.TPUCompilerParams

@@ -8,18 +8,27 @@ weights channel-wise), QAT program rewrite
 ranges), and the int8 inference path (MKLDNN/TensorRT int8 kernels).
 
 TPU-native redesign: quantization is a LAYER-TREE rewrite, not a graph
-pass — `QuantizedLinear` replaces `nn.Linear` in place and XLA does the
-rest:
- - weight-only int8 (`quantize_weights`): per-output-channel int8 weights
-   dequantized into the matmul's bf16 operand; XLA fuses the
-   dequant-multiply into the gemm prologue, halving/quartering weight HBM
-   traffic — the win that matters for memory-bound TPU decode.
- - static int8 activations (`PostTrainingQuantization`): calibration runs
-   record per-layer absmax; `run()` bakes activation scales so the gemm
-   runs int8 x int8 -> int32 on the MXU's native int8 path.
+pass — `QuantizedLinear` replaces `nn.Linear` in place:
+ - int8 end to end (`quantize_weights` + `FLAGS_pallas_int8`, the
+   default): per-output-channel int8 weights stay int8 THROUGH the gemm
+   — the Pallas kernel (ops.pallas.quant_matmul) quantizes the
+   activation stream per tensor (dynamic absmax, or the calibrated
+   `act_scale`) and runs int8 x int8 -> int32 on the MXU's native int8
+   path with a dequantize epilogue. Weight HBM traffic is 1/4 the f32
+   bytes AND the MXU runs at int8 rate — the win that matters for
+   memory-bound TPU decode.
+ - kill switch (`FLAGS_pallas_int8` off, or shapes the kernel cannot
+   tile): the pre-kernel XLA paths — weight-only mode dequantizes the
+   int8 weights into the matmul's float operand (XLA fuses the
+   dequant-multiply into the gemm prologue), static-activation mode
+   runs an XLA int8 dot.
+ - static int8 activations (`PostTrainingQuantization`): calibration
+   runs record per-layer absmax; `run()` bakes activation scales.
  - QAT (`QAT.quantize`): fake-quant straight-through estimators around
    weights+activations; `convert` strips them back to a quantized deploy
-   model.
+   model. The per-channel weight observer lives in
+   `nn.quant.PerChannelAbsMaxObserver` (one scale rule shared with the
+   kernel; docs/PARITY.md).
 """
 
 from __future__ import annotations
@@ -39,10 +48,12 @@ __all__ = ["QuantizedLinear", "quantize_weights",
 
 
 def _channel_scales(w: np.ndarray, bits: int = 8) -> np.ndarray:
-    """Per-output-channel symmetric scales for a [in, out] weight."""
-    absmax = np.abs(w).max(axis=0)
-    qmax = 2.0 ** (bits - 1) - 1
-    return np.maximum(absmax / qmax, 1e-8).astype(np.float32)
+    """Per-output-channel symmetric scales for a [in, out] weight —
+    delegates to the one observer rule (nn.quant.PerChannelAbsMaxObserver)
+    so slim, QAT and the Pallas int8 kernel can never disagree on the
+    quantization grid."""
+    from ..nn.quant import PerChannelAbsMaxObserver
+    return PerChannelAbsMaxObserver(quant_bits=bits, quant_axis=1).observe(w)
 
 
 class QuantizedLinear(Layer):
@@ -80,6 +91,27 @@ class QuantizedLinear(Layer):
 
     def forward(self, x):
         act_scale = self.act_scale
+        # kernel dispatch resolved OUTSIDE the traced fn so the path
+        # choice is stable for any cached trace; kill switch
+        # FLAGS_pallas_int8 -> the pre-kernel XLA paths below
+        from ..ops import pallas as pallas_ops
+        use_kernel = pallas_ops.kernel_enabled("int8_matmul")
+        if use_kernel:
+            # quant_matmul (and with it jax.experimental.pallas) loads
+            # only on a live-kernel path — the fallback paths keep the
+            # kernel layer's lazy-import contract
+            from ..ops.pallas.quant_matmul import matmul_shapes_supported
+            K, N = (int(s) for s in self.weight_q.shape)
+            if not matmul_shapes_supported(K, N):
+                pallas_ops.note_fallback("int8_matmul", "shape")
+                use_kernel = False
+
+        def _kernel(a, q, s, *b):
+            # weights stay int8 through the gemm; act_scale None =
+            # dynamic per-tensor quantization of the activation stream
+            from ..ops.pallas.quant_matmul import int8_linear
+            return int8_linear(a, q, s, bias=b[0] if b else None,
+                               act_scale=act_scale)
 
         def _wo(a, q, s, *b):
             w = q.astype(a.dtype) * s.astype(a.dtype)
@@ -96,7 +128,10 @@ class QuantizedLinear(Layer):
             y = y.astype(a.dtype)
             return y + b[0] if b else y
 
-        fn = _wo if act_scale is None else _int8
+        if use_kernel:
+            fn = _kernel
+        else:
+            fn = _wo if act_scale is None else _int8
         args = [x, self.weight_q, self.scale] + (
             [self.bias] if self.bias is not None else [])
         return apply(fn, *args, name="quantized_linear")

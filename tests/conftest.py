@@ -45,6 +45,30 @@ def pytest_configure(config):
         "serve: exercises the paddle_tpu.serving engine (engine global "
         "state — live engines, request-id counter — is reset around "
         "every test by the autouse _serving_isolation fixture)")
+    config.addinivalue_line(
+        "markers",
+        "pallas: runs ops.pallas kernel BODIES on the CPU test backend "
+        "via the Pallas interpreter (the autouse _pallas_interpret "
+        "fixture forces FLAGS_pallas_interpret for marked tests, so "
+        "kernel dispatch serves the real kernels instead of the XLA "
+        "fallbacks; fallback stats are reset around every test)")
+
+
+@pytest.fixture(autouse=True)
+def _pallas_interpret(request):
+    """``pallas``-marked tests run the real kernel bodies on CPU through
+    the Pallas interpreter (FLAGS_pallas_interpret); every test starts
+    with clean fallback stats so kill-switch tests can assert on exactly
+    the fallbacks they caused."""
+    import sys
+    if "paddle_tpu.ops.pallas" in sys.modules:
+        sys.modules["paddle_tpu.ops.pallas"].reset_pallas_stats()
+    if request.node.get_closest_marker("pallas"):
+        from paddle_tpu.core.flags import flag_scope
+        with flag_scope("pallas_interpret", True):
+            yield
+    else:
+        yield
 
 
 @pytest.fixture(autouse=True)

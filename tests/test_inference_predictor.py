@@ -93,6 +93,59 @@ def test_live_layer_int8_pass_parity():
     assert (out.argmax(-1) == ref.argmax(-1)).mean() >= 0.75
 
 
+class MLPNet(paddle.nn.Layer):
+    """Kernel-eligible head (128-aligned in/out): the int8 weight pass
+    keeps these weights int8 THROUGH the matmul (ops.pallas.quant_matmul)
+    instead of dequantizing to f32 at load."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = Linear(256, 128)
+        self.fc2 = Linear(128, 128)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+@pytest.mark.pallas
+def test_int8_pass_serves_the_kernel_with_parity():
+    """ISSUE 7 satellite pin: enable_int8 + FLAGS_pallas_int8 runs the
+    predictor's linears int8-end-to-end (W8A8-dynamic through the Pallas
+    kernel) with output parity to the f32 layer within quantization
+    error; the kill switch restores the pre-PR dequantize-to-float pass
+    bit for bit."""
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.ops import pallas as pallas_ops
+    paddle.seed(9)
+    m = MLPNet()
+    m.eval()
+    x = np.random.default_rng(6).normal(size=(4, 256)).astype(np.float32)
+    ref = m(paddle.to_tensor(x)).numpy()
+
+    def _int8_out():
+        cfg = inference.Config.from_layer(
+            MLPNet(), [InputSpec((4, 256), "float32")])
+        # fresh layer each build: quantize_weights rewrites in place
+        cfg.layer.set_state_dict(m.state_dict())
+        cfg.layer.eval()
+        cfg.enable_int8()
+        return inference.create_predictor(cfg).run([x])[0]
+
+    out_kernel = _int8_out()
+    assert not any(k[0] == "int8_matmul" and k[1] == "shape"
+                   for k in pallas_ops.PALLAS_STATS), \
+        "the 128-aligned MLP must serve the kernel, not the shape fallback"
+    rel = np.abs(out_kernel - ref).max() / np.abs(ref).max()
+    assert rel < 0.08, rel
+    with flag_scope("pallas_int8", False):
+        out_off = _int8_out()
+    # kill switch = the pre-PR weight-only pass: dequantize into the
+    # f32 gemm — and the kernel path really is a different computation
+    assert not np.array_equal(out_kernel, out_off)
+    rel = np.abs(out_off - ref).max() / np.abs(ref).max()
+    assert rel < 0.08, rel
+
+
 def test_jit_save_roundtrip_through_predictor(tmp_path):
     m = _net()
     x = _x(4)

@@ -1,0 +1,518 @@
+"""Pallas kernel-layer parity + kill-switch tests (ISSUE 7).
+
+Three kernels behind one dispatch convention (ops/pallas/__init__.py):
+fused chunked-CE, paged flash-decode, int8 quantized matmul. Each is
+pinned three ways here:
+
+- PARITY: the kernel body (run on CPU via the interpreter — the
+  ``pallas`` marker flips FLAGS_pallas_interpret) matches the reference
+  math to the module's documented tolerances;
+- KILL SWITCH: with the kernel's flag off, dispatch serves the XLA
+  fallback and the numbers are bit-identical to the pre-kernel
+  implementation;
+- OBSERVABILITY: fallbacks land in PALLAS_STATS and (monitor mode) the
+  ``pallas_fallback_total{kernel,reason}`` counter; ``kernels()``
+  enumerates the layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import chunked_ce as cce
+from paddle_tpu.ops import pallas as pallas_ops
+
+
+# ---------------------------------------------------------------------------
+# dispatch convention / registry
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_registry_enumerates_the_layer():
+    rows = {r["kernel"]: r for r in pallas_ops.kernels()}
+    assert set(rows) == {"flash_attention", "chunked_ce", "paged_decode",
+                         "int8_matmul"}
+    assert rows["chunked_ce"]["flag"] == "FLAGS_pallas_ce"
+    assert rows["paged_decode"]["flag"] == "FLAGS_pallas_paged_decode"
+    assert rows["int8_matmul"]["flag"] == "FLAGS_pallas_int8"
+    # CPU backend without the interpreter: nothing is live
+    assert not any(r["live"] for r in rows.values())
+    for r in rows.values():
+        assert r["fallback"]            # every kernel names its fallback
+
+
+@pytest.mark.pallas
+def test_kernel_registry_live_under_interpret():
+    rows = {r["kernel"]: r for r in pallas_ops.kernels()}
+    assert rows["chunked_ce"]["live"]
+    assert rows["paged_decode"]["live"]
+    assert rows["int8_matmul"]["live"]
+    with flag_scope("pallas_ce", False):
+        rows = {r["kernel"]: r for r in pallas_ops.kernels()}
+        assert not rows["chunked_ce"]["live"]
+        assert rows["chunked_ce"]["flag_value"] is False
+
+
+def test_fallbacks_counted_in_stats_and_registry():
+    from paddle_tpu.monitor import scoped_registry
+    with scoped_registry() as reg, flag_scope("monitor", True):
+        assert not pallas_ops.kernel_enabled("chunked_ce")  # CPU backend
+        with flag_scope("pallas_interpret", True), \
+                flag_scope("pallas_int8", False):
+            assert not pallas_ops.kernel_enabled("int8_matmul")
+    assert pallas_ops.PALLAS_STATS[("chunked_ce", "cpu_backend")] == 1
+    assert pallas_ops.PALLAS_STATS[("int8_matmul", "flag_off")] == 1
+    c = reg.counter("pallas_fallback_total")
+    assert c.value(kernel="chunked_ce", reason="cpu_backend") == 1
+    assert c.value(kernel="int8_matmul", reason="flag_off") == 1
+    # kernels() surfaces the observed fallbacks without inflating them
+    rows = {r["kernel"]: r for r in pallas_ops.kernels()}
+    assert rows["chunked_ce"]["fallbacks_seen"] == {"cpu_backend": 1}
+    assert pallas_ops.PALLAS_STATS[("chunked_ce", "cpu_backend")] == 1
+
+
+def test_monitor_report_kernels_mode(capsys):
+    """tools/monitor_report.py --kernels renders the live inventory."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "tools"))
+    import monitor_report
+    pallas_ops.note_fallback("chunked_ce", "cpu_backend")
+    assert monitor_report.main(["--kernels"]) == 0
+    out = capsys.readouterr().out
+    assert "ops.pallas kernel layer" in out
+    for name in ("flash_attention", "chunked_ce", "paged_decode",
+                 "int8_matmul"):
+        assert name in out
+    assert "FLAGS_pallas_ce=on" in out
+    assert "cpu_backend:1" in out
+
+
+# ---------------------------------------------------------------------------
+# fused chunked-CE
+# ---------------------------------------------------------------------------
+
+
+def _dense_nll(lg, lab):
+    lg32 = lg.astype(jnp.float32)
+    return (jax.nn.logsumexp(lg32, -1)
+            - jnp.take_along_axis(lg32, lab[:, None], 1)[:, 0])
+
+
+@pytest.mark.pallas
+@pytest.mark.parametrize("N,V,chunk", [(8, 50, 16), (4, 5, 8),
+                                       (24, 129, 64), (7, 256, 256)])
+def test_ce_kernel_parity_fwd_bwd(N, V, chunk):
+    rng = np.random.RandomState(0)
+    lg = jnp.asarray((rng.randn(N, V) * 3).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+    assert pallas_ops.kernel_enabled("chunked_ce", note=False)
+    got = cce.hard_nll(lg, lab, chunk=chunk)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_dense_nll(lg, lab)),
+                               rtol=1e-6, atol=1e-6)
+    g_ref = jax.grad(lambda l: _dense_nll(l, lab).sum())(lg)
+    g_got = jax.grad(lambda l: cce.hard_nll(l, lab, chunk=chunk).sum())(lg)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.pallas
+def test_ce_kernel_bf16_f32_accumulation():
+    rng = np.random.RandomState(1)
+    lg = jnp.asarray(rng.randn(6, 40).astype(np.float32)) \
+        .astype(jnp.bfloat16)
+    lab = jnp.asarray(rng.randint(0, 40, (6,)).astype(np.int32))
+    got = jax.jit(lambda l: cce.hard_nll(l, lab, chunk=16))(lg)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_dense_nll(lg, lab)),
+                               rtol=2e-2, atol=1e-2)
+    g = jax.grad(lambda l: cce.hard_nll(l, lab, chunk=16).sum())(lg)
+    assert g.dtype == jnp.bfloat16
+
+
+@pytest.mark.pallas
+def test_ce_kernel_through_cross_entropy_epilogue():
+    """F.cross_entropy keeps ignore_index / class weights / reduction in
+    the epilogue OUTSIDE the kernel — parity vs the dense path."""
+    rng = np.random.RandomState(2)
+    logits_np = (rng.randn(8, 50) * 2).astype(np.float32)
+    labels_np = rng.randint(0, 50, (8,)).astype(np.int64)
+    labels_np[2] = -100
+    w_np = rng.uniform(0.2, 2.0, (50,)).astype(np.float32)
+    with flag_scope("chunked_ce_threshold", 8), \
+            flag_scope("chunked_ce_chunk", 16):
+        x1 = Tensor(logits_np)
+        x1.stop_gradient = False
+        l1 = F.cross_entropy(x1, Tensor(labels_np), weight=Tensor(w_np))
+    with flag_scope("chunked_ce_threshold", 0):
+        x2 = Tensor(logits_np)
+        x2.stop_gradient = False
+        l2 = F.cross_entropy(x2, Tensor(labels_np), weight=Tensor(w_np))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    l1.backward()
+    l2.backward()
+    np.testing.assert_allclose(np.asarray(x1.grad._data),
+                               np.asarray(x2.grad._data),
+                               rtol=1e-5, atol=1e-7)
+    assert np.abs(np.asarray(x1.grad._data)[2]).max() == 0.0
+
+
+@pytest.mark.pallas
+def test_ce_kill_switch_is_bit_identical_to_pre_kernel_path():
+    """FLAGS_pallas_ce off routes hard_nll to the XLA streaming op —
+    the EXACT pre-kernel implementation (same function), so fallback
+    outputs and gradients are bitwise equal to it."""
+    rng = np.random.RandomState(3)
+    lg = jnp.asarray(rng.randn(6, 50).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, 50, (6,)).astype(np.int32))
+    with flag_scope("pallas_ce", False):
+        off = cce.hard_nll(lg, lab, chunk=16)
+        g_off = jax.grad(lambda l: cce.hard_nll(l, lab, chunk=16).sum())(lg)
+    direct = cce._ce_hard(16, lg, lab)
+    g_direct = jax.grad(lambda l: cce._ce_hard(16, l, lab).sum())(lg)
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(direct))
+    np.testing.assert_array_equal(np.asarray(g_off), np.asarray(g_direct))
+    assert ("chunked_ce", "flag_off") in pallas_ops.PALLAS_STATS
+    # and the kernel path agrees with the fallback to streaming-CE tol
+    on = cce.hard_nll(lg, lab, chunk=16)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.pallas
+def test_ce_kill_switch_not_defeated_by_eager_op_cache():
+    """The Pallas dispatch outcome rides F.cross_entropy's eager-jit
+    cache token: flipping FLAGS_pallas_ce between same-signature calls
+    must re-dispatch (serving the fallback), not replay the cached
+    kernel trace."""
+    rng = np.random.RandomState(7)
+    logits_np = (rng.randn(8, 64) * 2).astype(np.float32)
+    labels_np = rng.randint(0, 64, (8,)).astype(np.int64)
+    with flag_scope("chunked_ce_threshold", 8), \
+            flag_scope("chunked_ce_chunk", 16):
+        x1 = Tensor(logits_np)
+        x1.stop_gradient = False
+        l_on = F.cross_entropy(x1, Tensor(labels_np))
+        with flag_scope("pallas_ce", False):
+            x2 = Tensor(logits_np)
+            x2.stop_gradient = False
+            l_off = F.cross_entropy(x2, Tensor(labels_np))
+    # the off-call really took the fallback path (a stale cached kernel
+    # trace would never note the flag_off fallback)
+    assert ("chunked_ce", "flag_off") in pallas_ops.PALLAS_STATS
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+
+
+@pytest.mark.pallas
+def test_ce_block_env_override_validated():
+    import os
+    os.environ["PTPU_CE_BLOCK_N"] = "bogus"
+    try:
+        with pytest.raises(ValueError, match="PTPU_CE_BLOCK_N"):
+            cce.hard_nll(jnp.zeros((4, 32)), jnp.zeros((4,), jnp.int32),
+                         chunk=16)
+    finally:
+        del os.environ["PTPU_CE_BLOCK_N"]
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode
+# ---------------------------------------------------------------------------
+
+
+def _paged_state(rng, B=3, MB=4, bs=4, H=2, D=8, P=10):
+    """Pools + tables + positions with slots at different fill levels,
+    written through the production write_pages path."""
+    from paddle_tpu.serving.kv_cache import write_pages
+    kp = jnp.zeros((P, bs, H, D), jnp.float32)
+    vp = jnp.zeros((P, bs, H, D), jnp.float32)
+    tbl = np.zeros((B, MB), np.int32)
+    tbl[0, :3] = [1, 2, 3]
+    tbl[1, :1] = [4]
+    tbl[2, :4] = [6, 7, 8, 9]
+    tbl = jnp.asarray(tbl)
+    pos = jnp.asarray(np.array([9, 2, 14], np.int32))
+    for b in range(B):
+        n = int(pos[b]) + 1
+        kp = write_pages(kp, jnp.asarray(
+            rng.randn(1, n, H, D).astype(np.float32)),
+            tbl[b:b + 1], jnp.zeros((1,), jnp.int32))
+        vp = write_pages(vp, jnp.asarray(
+            rng.randn(1, n, H, D).astype(np.float32)),
+            tbl[b:b + 1], jnp.zeros((1,), jnp.int32))
+    return kp, vp, tbl, pos
+
+
+def _dense_decode_ref(q, kp, vp, tbl, pos, scale):
+    """The XLA fallback's math: gather_pages + masked softmax."""
+    from paddle_tpu.serving.kv_cache import gather_pages
+    gk, gv = gather_pages(kp, tbl), gather_pages(vp, tbl)
+    cols = jnp.arange(gk.shape[1])
+    mask = jnp.where(cols[None, :] <= pos[:, None], 0.0, -1e30)
+    s = jnp.einsum("bhd,bkhd->bhk", q, gk) * scale + mask[:, None, :]
+    pr = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", pr, gv)
+
+
+@pytest.mark.pallas
+def test_paged_decode_kernel_parity():
+    from paddle_tpu.ops.pallas.paged_decode import paged_decode_attention
+    rng = np.random.RandomState(0)
+    kp, vp, tbl, pos = _paged_state(rng)
+    q = jnp.asarray(rng.randn(3, 2, 8).astype(np.float32))
+    scale = 1.0 / np.sqrt(8)
+    ref = _dense_decode_ref(q, kp, vp, tbl, pos, scale)
+    got = paged_decode_attention(q, kp, vp, tbl, pos, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # under jit (the serving decode program wraps it)
+    got_j = jax.jit(lambda *a: paged_decode_attention(
+        *a, scale=scale))(q, kp, vp, tbl, pos)
+    np.testing.assert_allclose(np.asarray(got_j), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _gpt_paged_decode_logits(pallas_on, scan_on=True):
+    """One prefill + one batched decode step through GPTModel over the
+    paged cache; returns the decode-step hidden states."""
+    from paddle_tpu.models.gpt import GPTModel, gpt_tiny
+    from paddle_tpu.serving.kv_cache import PagedCacheView, PagedKVCache
+    paddle.seed(11)
+    cfg = gpt_tiny()
+    m = GPTModel(cfg)
+    m.eval()
+    cache = PagedKVCache(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=10, block_size=4, max_slots=2,
+                         max_blocks_per_slot=4)
+    assert cache.alloc_slot(0, 7) and cache.alloc_slot(1, 4)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, cfg.vocab_size, (1, n)).astype(np.int32)
+               for n in (6, 3)]
+    ctx = flag_scope("pallas_paged_decode", pallas_on)
+    with ctx, flag_scope("scan_decode", scan_on), paddle.no_grad():
+        for slot, ids in enumerate(prompts):
+            view = PagedCacheView(cache.k, cache.v,
+                                  cache.table_array([slot]))
+            _, nc = m(paddle.to_tensor(ids), caches=view,
+                      cache_pos=paddle.to_tensor(np.zeros(1, np.int32)))
+            cache.update(nc.k._data, nc.v._data)
+        dec = rng.randint(0, cfg.vocab_size, (2, 1)).astype(np.int32)
+        view = PagedCacheView(cache.k, cache.v, cache.table_array([0, 1]))
+        hd, _ = m(paddle.to_tensor(dec), caches=view,
+                  cache_pos=paddle.to_tensor(np.array([6, 3], np.int32)))
+    return np.asarray(hd._data)
+
+
+@pytest.mark.pallas
+@pytest.mark.serve
+def test_paged_decode_token_exact_in_gpt_model():
+    """Decode through the full GPT paged path (scan layout): kernel-on
+    states match the dense fallback to float tolerance and the greedy
+    token choice is EXACT."""
+    h_off = _gpt_paged_decode_logits(pallas_on=False)
+    h_on = _gpt_paged_decode_logits(pallas_on=True)
+    np.testing.assert_allclose(h_on, h_off, rtol=1e-5, atol=1e-5)
+    assert (h_on.argmax(-1) == h_off.argmax(-1)).all()
+
+
+@pytest.mark.pallas
+@pytest.mark.serve
+def test_paged_decode_kill_switch_loop_layout():
+    """Kill switch off + loop layout = the pre-kernel gather+SDPA path;
+    kernel-on loop layout agrees with it."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # scan fallback
+        h_off = _gpt_paged_decode_logits(pallas_on=False, scan_on=False)
+        h_on = _gpt_paged_decode_logits(pallas_on=True, scan_on=False)
+    assert ("paged_decode", "flag_off") in pallas_ops.PALLAS_STATS
+    np.testing.assert_allclose(h_on, h_off, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized matmul
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.pallas
+def test_int8_matmul_exact_vs_int_reference():
+    """The kernel's integer arithmetic is EXACT: int8 x int8 -> int32
+    matches the XLA int dot bit for bit; only the one f32 epilogue
+    multiply separates it from the closed form."""
+    from paddle_tpu.ops.pallas.quant_matmul import (
+        int8_matmul, quantize_per_channel, quantize_per_tensor)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(20, 256).astype(np.float32))
+    w = jnp.asarray((rng.randn(256, 128) * 0.05).astype(np.float32))
+    w_q, w_s = quantize_per_channel(w)
+    x_q, a_s = quantize_per_tensor(x)
+    got = int8_matmul(x_q, w_q, w_s, a_s)
+    ref = (jnp.matmul(x_q.astype(jnp.int32), w_q.astype(jnp.int32))
+           .astype(jnp.float32) * (a_s * w_s)[None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.pallas
+def test_int8_matmul_within_quantization_error_of_f32():
+    from paddle_tpu.ops.pallas.quant_matmul import int8_linear
+    from paddle_tpu.ops.pallas.quant_matmul import quantize_per_channel
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 5, 256).astype(np.float32))
+    w = jnp.asarray((rng.randn(256, 128) * 0.05).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+    w_q, w_s = quantize_per_channel(w)
+    y = int8_linear(x, w_q, w_s, bias=b)
+    ref = jnp.matmul(x, w) + b
+    rel = (np.abs(np.asarray(y) - np.asarray(ref)).max()
+           / np.abs(np.asarray(ref)).max())
+    assert rel < 0.06, rel
+
+
+@pytest.mark.pallas
+def test_quantized_linear_keeps_weights_int8_through_matmul():
+    """slim.QuantizedLinear + FLAGS_pallas_int8: the gemm consumes the
+    int8 weights directly (W8A8-dynamic), within quantization error of
+    the f32 linear; the static-act mode matches the XLA int8 dot."""
+    from paddle_tpu import slim
+    from paddle_tpu.nn import Linear
+    paddle.seed(0)
+    lin = Linear(256, 128)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(4, 256).astype(np.float32))
+    ref = lin(x).numpy()
+    q = slim.QuantizedLinear.from_linear(lin)
+    assert q.weight_q.numpy().dtype == np.int8
+    out = q(x).numpy()
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+    # static calibrated act_scale: kernel == the XLA int8 dot fallback
+    a_s = float(np.abs(x.numpy()).max() / 127.0)
+    q2 = slim.QuantizedLinear.from_linear(lin, act_scale=a_s)
+    out_k = q2(x).numpy()
+    with flag_scope("pallas_int8", False):
+        out_x = q2(x).numpy()
+    np.testing.assert_allclose(out_k, out_x, rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_linear_kill_switch_bit_identical():
+    """Flag off (or a CPU backend without the interpreter — the tier-1
+    default) = the pre-kernel dequantize-to-float matmul, bit for bit."""
+    from paddle_tpu import slim
+    from paddle_tpu.nn import Linear
+    paddle.seed(1)
+    lin = Linear(64, 48)        # not 128-aligned: kernel-ineligible too
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(4, 64).astype(np.float32))
+    q = slim.QuantizedLinear.from_linear(lin)
+    out = q(x).numpy()
+    wq = q.weight_q.numpy()
+    s = q.scale.numpy()
+    pre_pr = (x.numpy() @ (wq.astype(np.float32) * s)
+              + lin.bias.numpy())
+    np.testing.assert_allclose(out, pre_pr, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.pallas
+def test_int8_shape_fallback_counted():
+    from paddle_tpu import slim
+    from paddle_tpu.nn import Linear
+    paddle.seed(2)
+    lin = Linear(100, 48)       # K, N not 128-aligned
+    x = paddle.to_tensor(np.ones((2, 100), np.float32))
+    slim.QuantizedLinear.from_linear(lin)(x)
+    assert ("int8_matmul", "shape") in pallas_ops.PALLAS_STATS
+
+
+def test_observer_is_the_one_scale_rule():
+    """nn.quant.PerChannelAbsMaxObserver == slim._channel_scales ==
+    ops.pallas.quantize_per_channel: one quantization grid everywhere."""
+    from paddle_tpu import slim
+    from paddle_tpu.nn.quant import PerChannelAbsMaxObserver
+    from paddle_tpu.ops.pallas.quant_matmul import quantize_per_channel
+    rng = np.random.RandomState(4)
+    w = (rng.randn(64, 32) * 0.1).astype(np.float32)
+    obs = PerChannelAbsMaxObserver(quant_bits=8, quant_axis=1)
+    s_obs = obs.observe(w)
+    np.testing.assert_allclose(s_obs, slim._channel_scales(w), rtol=1e-7)
+    q_k, s_k = quantize_per_channel(jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(s_k), s_obs, rtol=1e-6)
+    q_obs, _ = obs.quantize(w)
+    np.testing.assert_array_equal(np.asarray(q_k), q_obs)
+    # running-absmax accumulation across observe() calls
+    s2 = obs.observe(w * 0.5)
+    np.testing.assert_allclose(s2, s_obs, rtol=1e-6)
+
+
+@pytest.mark.pallas
+def test_amp_int8_linear_flag_gated():
+    """FLAGS_amp_int8_matmul routes eligible F.linear calls under
+    autocast through the int8 kernel; the backward is the
+    straight-through dense pair, so gradients equal the f32 linear's."""
+    from paddle_tpu import amp
+    from paddle_tpu.nn import Linear
+    paddle.seed(3)
+    lin = Linear(128, 128)
+    x_np = np.random.RandomState(5).randn(4, 128).astype(np.float32)
+    ref = F.linear(paddle.to_tensor(x_np), lin.weight, lin.bias).numpy()
+
+    x1 = paddle.to_tensor(x_np)
+    x1.stop_gradient = False
+    with flag_scope("amp_int8_matmul", True), \
+            amp.auto_cast(level="O1", dtype="float32"):
+        y = F.linear(x1, lin.weight, lin.bias)
+    rel = np.abs(y.numpy() - ref).max() / np.abs(ref).max()
+    assert rel < 0.06, rel
+    assert not np.allclose(y.numpy(), ref, atol=1e-7)   # int8 really ran
+    y.sum().backward()
+    x2 = paddle.to_tensor(x_np)
+    x2.stop_gradient = False
+    F.linear(x2, lin.weight, lin.bias).sum().backward()
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # without the flag: the plain matmul path, bit-identical to ref
+    with amp.auto_cast(level="O1", dtype="float32"):
+        y_off = F.linear(paddle.to_tensor(x_np), lin.weight, lin.bias)
+    np.testing.assert_array_equal(y_off.numpy(), ref)
+
+
+# ---------------------------------------------------------------------------
+# bench record gating
+# ---------------------------------------------------------------------------
+
+
+def test_bench_kernels_metrics_are_gated_by_check_bench():
+    """kernel_*_ms lines gate as lower-is-better, kernel_*_gbps as
+    higher-is-better — the BENCH_kernels.json self-gate contract."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "tools"))
+    import check_bench  # noqa: E402
+    old = [
+        {"metric": "kernel_ce_fused_ms", "value": 10.0, "unit": "ms"},
+        {"metric": "kernel_ce_fused_gbps", "value": 50.0, "unit": "GB/s"},
+        {"metric": "kernel_paged_decode_ms", "value": 5.0, "unit": "ms"},
+    ]
+    new_ok = [
+        {"metric": "kernel_ce_fused_ms", "value": 10.5, "unit": "ms"},
+        {"metric": "kernel_ce_fused_gbps", "value": 48.0, "unit": "GB/s"},
+        {"metric": "kernel_paged_decode_ms", "value": 5.1, "unit": "ms"},
+    ]
+    assert check_bench.compare_common(old, new_ok) == []
+    new_bad = [
+        {"metric": "kernel_ce_fused_ms", "value": 14.0, "unit": "ms"},
+        {"metric": "kernel_ce_fused_gbps", "value": 30.0, "unit": "GB/s"},
+    ]
+    problems = check_bench.compare_common(old, new_bad)
+    assert len(problems) == 2
+    assert any("kernel_ce_fused_ms" in p for p in problems)
+    assert any("kernel_ce_fused_gbps" in p for p in problems)

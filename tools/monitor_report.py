@@ -19,6 +19,14 @@ stream — prints:
   budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
 - everything else (counters/gauges) as a flat table.
 
+``--kernels`` needs no input file: it enumerates the live
+``paddle_tpu.ops.pallas`` kernel registry — per kernel the kill-switch
+flag and its current value, whether dispatch would serve the Pallas body
+on THIS backend (``live``), the XLA fallback that serves otherwise, and
+any fallback counts observed in this process (``PALLAS_STATS``; the
+persistent view is the ``pallas_fallback_total{kernel,reason}`` counter
+in a monitor dump, rendered by the default counter table).
+
 ``--flight`` switches input format entirely: the argument is a crash
 flight-recorder dump (monitor/flight_recorder.py JSON) and the report
 shows trip reason, environment fingerprint, a *recovery timeline*
@@ -29,6 +37,7 @@ the last-N step records.
 Usage:
     python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
+    python tools/monitor_report.py --kernels
 
 Exit code: 0 on success (including an empty report), 2 on usage/read
 errors. Append-only input is expected: the NEWEST sample per
@@ -342,6 +351,28 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     return "\n".join(out).rstrip() + "\n"
 
 
+def render_kernels() -> str:
+    """--kernels: the live ops.pallas kernel-layer inventory (flag
+    matrix, dispatch status on this backend, observed fallbacks)."""
+    from paddle_tpu.ops import pallas as pallas_ops
+    rows = []
+    for r in pallas_ops.kernels():
+        flag = r["flag"] or "(shape gate)"
+        if r["flag_value"] is not None:
+            flag += f"={'on' if r['flag_value'] else 'off'}"
+        seen = ", ".join(f"{k}:{v}" for k, v in
+                         sorted(r["fallbacks_seen"].items())) or "-"
+        rows.append([r["kernel"], flag,
+                     "live" if r["live"] else "fallback",
+                     r["fallback"], seen])
+    lines = _table("ops.pallas kernel layer (this backend)",
+                   ["kernel", "kill switch", "dispatch", "XLA fallback",
+                    "fallbacks seen"], rows)
+    lines.append("(docs/PERF_KERNELS.md; persistent fallback counts: "
+                 "pallas_fallback_total in a monitor dump)")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -370,10 +401,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve = "--serve" in argv
     if serve:
         argv.remove("--serve")
-    if len(argv) != 1:
+    kernels = "--kernels" in argv
+    if kernels:
+        argv.remove("--kernels")
+    if len(argv) != (0 if kernels else 1):
         print(__doc__, file=sys.stderr)
         return 2
     sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    if kernels:
+        print(render_kernels(), end="")
+        return 0
     if flight:
         import json
         try:
