@@ -588,6 +588,7 @@ def bench_serve(quick: bool = False) -> list:
                         max_new_range=(16, 48),
                         vocab_size=cfg.vocab_size, seed=0,
                         sampling=SamplingParams())
+    from paddle_tpu.testing import chaos
     model = GPTForPretraining(cfg)
     engine = ServingEngine(model, serve_cfg)
     t0 = time.perf_counter()
@@ -608,6 +609,16 @@ def bench_serve(quick: bool = False) -> list:
         f"ttft p50 {summary['ttft_p50_s']*1e3:.1f} ms, "
         f"mean occupancy {summary['mean_decode_occupancy']:.2f}, "
         f"preemptions {summary['preemptions']}")
+    if chaos.active():
+        # `bench.py --serve --chaos <spec>` wires the injector through
+        # the serving bench (sites serve.*; run_open_loop survives
+        # shed/watchdog outcomes and counts them)
+        log(f"serve[{name}] chaos fires: {chaos.fired()}")
+    avail, shed = serve_resilience_metrics(summary)
+    log(f"serve[{name}]: availability {avail:.1f}%, shed rate "
+        f"{shed:.1f}% (rejected {summary['requests_rejected']}, "
+        f"failed {summary['requests_failed']}, watchdog trips "
+        f"{summary['watchdog_trips']})")
     return [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
@@ -621,7 +632,27 @@ def bench_serve(quick: bool = False) -> list:
                     vs_baseline=1.0),
         metric_line(f"serve_{name}_ttft_p50_ms",
                     summary["ttft_p50_s"] * 1e3, "ms", vs_baseline=1.0),
+        metric_line("serve_availability_pct", avail, "%",
+                    vs_baseline=1.0),
+        metric_line("serve_shed_rate", shed, "shed%", vs_baseline=1.0),
     ]
+
+
+def serve_resilience_metrics(summary: dict) -> tuple:
+    """(availability_pct, shed_rate_pct) of an open-loop serving run:
+    availability = requests that completed / requests offered; shed rate
+    = requests refused or dropped by admission control (client-side
+    rejections + policy sheds + queued expiries) / offered. Failed/
+    drained requests count against availability but are not "shed" —
+    they were admitted."""
+    offered = max(int(summary.get("num_requests") or 0), 1)
+    completed = int(summary.get("requests_completed") or 0)
+    # only QUEUED expiries are shed; an in-flight expiry was admitted
+    # and decoded, so it counts against availability alone
+    shed = (int(summary.get("requests_rejected") or 0)
+            + int(summary.get("requests_shed") or 0)
+            + int(summary.get("requests_expired_queued") or 0))
+    return 100.0 * completed / offered, 100.0 * shed / offered
 
 
 def bench_kernels(quick: bool = False) -> list:

@@ -212,6 +212,16 @@ define_flag("chaos_seed", 0,
             "Seed for probability-based chaos sites: the same "
             "(seed, site, occurrence) triple always makes the same "
             "fire/no-fire decision, so chaos runs replay exactly.")
+define_flag("serve_watchdog_s", 0.0,
+            "Wall-clock watchdog (seconds) for serving prefill/decode "
+            "dispatches (paddle_tpu.serving.engine): a dispatch that "
+            "does not return within the budget raises "
+            "DecodeWatchdogError (with a decode_watchdog flight event "
+            "and dump) instead of stalling the serving loop forever. "
+            "0 (default) = no watchdog, direct dispatch — zero "
+            "overhead. The budget covers a whole dispatch including a "
+            "cold compile; warmup() first, or set it well above "
+            "cold-start time. Modeled on FLAGS_collective_timeout_s.")
 define_flag("pallas_ce", True,
             "Serve the streamed (chunked) hard-label cross-entropy with "
             "the fused Pallas kernel (ops.pallas.chunked_ce): online f32 "

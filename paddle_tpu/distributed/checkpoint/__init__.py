@@ -151,11 +151,10 @@ def _fsync_dir(path: str) -> None:
 def _record_event(event: str, **fields) -> None:
     """Flight-recorder event, gated exactly like TrainStep records."""
     try:
-        from ...monitor import flight_recorder as _flight
-        if _flight.enabled():
-            _flight.get_flight_recorder().record_event(event, **fields)
+        from ...monitor.flight_recorder import safe_record_event
     except Exception:
-        pass
+        return
+    safe_record_event(event, **fields)
 
 
 def _commit(tmp: str, final: str, leaves: Dict[str, dict],

@@ -53,7 +53,8 @@ class AOTProgram:
                  on_attribute: Optional[Callable[[str, Any, Any], None]]
                  = None):
         self.kind = kind
-        self._jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+        self.donate_argnums = tuple(donate_argnums)
+        self._jitted = jax.jit(fn, donate_argnums=self.donate_argnums)
         self._on_attribute = on_attribute
         self._compiled: Any = None
         self.heals = 0

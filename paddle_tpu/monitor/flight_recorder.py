@@ -47,16 +47,20 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
-           "enabled", "trip_dump", "load_dump", "RECOVERY_EVENTS"]
+           "enabled", "safe_record_event", "trip_dump", "load_dump",
+           "RECOVERY_EVENTS"]
 
 _EVENT_CAPACITY = 128
 
 # event names that make up a run's recovery timeline (emitters:
 # distributed/checkpoint, distributed/collective, jit/to_static,
-# testing/chaos); monitor_report.py --flight renders these separately
+# testing/chaos, serving/engine); monitor_report.py --flight renders
+# these separately
 RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
                    "collective_timeout", "nonfinite_skip", "preempted",
-                   "trip", "chaos")
+                   "trip", "chaos", "request_failed", "request_expired",
+                   "request_cancelled", "request_drained", "request_shed",
+                   "decode_watchdog", "overload", "drained")
 
 
 def _json_safe(v: Any) -> Any:
@@ -329,6 +333,20 @@ def enabled() -> bool:
     ``FLAGS_flight_recorder``."""
     from ..core.flags import get_flag
     return bool(get_flag("monitor")) or bool(get_flag("flight_recorder"))
+
+
+def safe_record_event(event: str, **fields) -> None:
+    """Best-effort flight event: no-op unless recording is enabled
+    (same gate as TrainStep records), and never raises — forensics must
+    not take the emitting loop down. The one helper behind every
+    guarded ``record_event`` call site (checkpoint fallbacks, serving
+    lifecycle, collective timeouts)."""
+    try:
+        if not enabled():
+            return
+        get_flight_recorder().record_event(event, **fields)
+    except Exception:
+        pass
 
 
 def trip_dump(step: Optional[int] = None, reason: str = "nan_watchdog",

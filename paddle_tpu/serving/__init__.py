@@ -28,23 +28,36 @@ from .detok import StreamingDetokenizer  # noqa: F401
 from .engine import ServingConfig, ServingEngine  # noqa: F401
 from .kv_cache import (BlockAllocator, PagedCacheView,  # noqa: F401
                        PagedKVCache, PagedLayerCache)
-from .loadgen import LoadSpec, build_requests, run_open_loop  # noqa: F401
+from .loadgen import (LoadSpec, TokenBucket, build_requests,  # noqa: F401
+                      run_open_loop)
+from .resilience import (DecodeWatchdogError, DrainLatch,  # noqa: F401
+                         DrainReport, EngineDrained, OverloadDetector,
+                         ServerOverloaded, load_drain_snapshot,
+                         requests_from_snapshot, save_drain_snapshot)
 from .sampling import SamplingParams, sample_tokens  # noqa: F401
-from .scheduler import BucketTable, Request, Scheduler  # noqa: F401
+from .scheduler import (TERMINAL_OUTCOMES, BucketTable,  # noqa: F401
+                        Request, Scheduler)
 
 __all__ = [
     "ServingConfig", "ServingEngine", "Request", "SamplingParams",
     "BucketTable", "Scheduler", "PagedKVCache", "PagedCacheView",
     "PagedLayerCache", "BlockAllocator", "StreamingDetokenizer",
-    "LoadSpec", "build_requests", "run_open_loop", "reset",
+    "LoadSpec", "TokenBucket", "build_requests", "run_open_loop",
+    "ServerOverloaded", "EngineDrained", "DecodeWatchdogError",
+    "DrainLatch", "DrainReport", "OverloadDetector",
+    "save_drain_snapshot", "load_drain_snapshot",
+    "requests_from_snapshot", "TERMINAL_OUTCOMES", "reset",
 ]
 
 
 def reset() -> None:
     """Tear down process-global serving state (conftest autouse): shut
-    down live engines, restart the request-id counter, and clear the
-    scan-fallback warn-once set + counter so fallback-telemetry
-    assertions are order-independent."""
+    down live engines — which restores any drain-latch signal handlers
+    and joins/abandons live watchdog threads (their chaos hangs are
+    cancelled first, so a hung worker cannot outlive its test) — then
+    restart the request-id counter and clear the scan-fallback warn-once
+    set + counter so fallback-telemetry assertions are
+    order-independent."""
     from . import engine as _engine, scheduler as _scheduler
     from ..nn import scan as _scan
     for e in list(_engine._LIVE_ENGINES):

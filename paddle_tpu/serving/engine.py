@@ -31,6 +31,7 @@ The execution model (ISSUE 6; docs/SERVING.md):
 
 from __future__ import annotations
 
+import threading
 import time
 import weakref
 from dataclasses import dataclass
@@ -45,11 +46,17 @@ from ..core.random import trace_rng
 from ..jit.aot import AOTProgram
 from ..jit.functional import bind, buffer_arrays, param_arrays
 from ..monitor import get_registry
+from ..monitor import flight_recorder as _flight
+from ..testing import chaos
 from .detok import StreamingDetokenizer
 from .kv_cache import PagedCacheView, PagedKVCache, blocks_needed
+from .resilience import (DecodeWatchdogError, DispatchWorker, DrainLatch,
+                         DrainReport, EngineDrained, OverloadDetector,
+                         ServerOverloaded, request_spec,
+                         save_drain_snapshot)
 from .sampling import SamplingParams, sample_tokens
-from .scheduler import (AdmissionGroup, BucketTable, Request, RequestState,
-                        Scheduler)
+from .scheduler import (QUEUE_POLICIES, AdmissionGroup, BucketTable,
+                        Request, RequestState, Scheduler)
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
@@ -88,8 +95,26 @@ class ServingConfig:
     seed: int = 0
     cache_dtype: str = "float32"
     detokenizer: Optional[StreamingDetokenizer] = None
+    #: bounded-queue shedding policy: reject-new | drop-oldest | priority
+    queue_policy: str = "reject-new"
+    #: queue-delay EWMA overload detector: > 0 arms it — while the EWMA
+    #: of head-of-queue delay exceeds this, every new submit is shed
+    #: with a typed ServerOverloaded. 0 (default) = detector off.
+    overload_threshold_s: float = 0.0
+    overload_alpha: float = 0.3
+    overload_exit_frac: float = 0.5
+    #: graceful-drain grace period: how long a drain keeps decoding
+    #: in-flight sequences before snapshotting the rest
+    drain_budget_s: float = 5.0
+    #: where drain snapshots commit (drain_<n> dirs); None = drain()
+    #: refuses to discard pending work
+    drain_dir: Optional[str] = None
 
     def resolve(self, model_max_positions: Optional[int]) -> None:
+        if self.queue_policy not in QUEUE_POLICIES:
+            raise ValueError(
+                f"unknown queue_policy {self.queue_policy!r}; one of "
+                f"{QUEUE_POLICIES}")
         if model_max_positions is not None:
             self.max_context_len = min(self.max_context_len,
                                        int(model_max_positions))
@@ -144,7 +169,18 @@ class ServingEngine:
         self.buckets = BucketTable(c.prefill_buckets, c.batch_buckets)
         self.scheduler = Scheduler(self.cache, self.buckets,
                                    max_queue=c.max_queue, clock=clock,
-                                   max_seq_len=c.max_context_len)
+                                   max_seq_len=c.max_context_len,
+                                   policy=c.queue_policy,
+                                   on_event=self._on_request_event)
+        self._overload = (OverloadDetector(
+            c.overload_threshold_s, alpha=c.overload_alpha,
+            exit_frac=c.overload_exit_frac)
+            if c.overload_threshold_s > 0 else None)
+        self._drain_latch: Optional[DrainLatch] = None
+        self._draining = False
+        self._drained = False
+        self._watchdog_threads: List[threading.Thread] = []
+        self._watchdog_worker: Optional[DispatchWorker] = None
         self._programs: Dict[tuple, AOTProgram] = {}
         self._programs_info: Dict[str, dict] = {}
         self._key = jax.random.key(int(c.seed))
@@ -203,11 +239,19 @@ class ServingEngine:
             "serving executable builds by program kind").inc(kind=kind)
 
     def _donate(self) -> tuple:
+        from ..core.flags import get_flag
         from ..jit.to_static import _donation_safe
         # pools are the 2nd/3rd argument of both program kinds; donation
         # keeps decode's HBM footprint at ONE pool copy (skipped on the
         # cpu+persistent-cache test backend — the jax 0.4.37 scan+donate
-        # aliasing hazard, see _donation_safe)
+        # aliasing hazard, see _donation_safe). An armed watchdog also
+        # disables donation: a tripped dispatch is ABANDONED mid-flight,
+        # and retrying the step is only sound while the live pools are
+        # neither invalidated (donated away) nor mutated in place by the
+        # zombie thread — the documented trade is one extra pool copy
+        # for retryable trips.
+        if float(get_flag("serve_watchdog_s") or 0.0) > 0.0:
+            return ()
         return (1, 2) if _donation_safe() else ()
 
     def _get_decode(self) -> AOTProgram:
@@ -217,12 +261,17 @@ class ServingEngine:
             return prog
 
         def decode_fn(params, k, v, table, pos, tokens, active, rng,
-                      temps, top_ks, top_ps):
+                      temps, top_ks, top_ps, poison):
             logits, k, v = self._fwd(params, tokens[:, None], k, v,
                                      table, pos)
-            toks = sample_tokens(logits[:, -1, :], rng, temps, top_ks,
-                                 top_ps)
-            return jnp.where(active, toks, 0), k, v
+            # poison is all-zeros outside chaos (bit-transparent); a NaN
+            # entry models a slot whose forward went non-finite. `ok` is
+            # the per-slot fault-isolation flag: one bad request fails
+            # alone, the rest of the batch streams on.
+            row = logits[:, -1, :] + poison[:, None]
+            ok = jnp.isfinite(row).all(axis=-1)
+            toks = sample_tokens(row, rng, temps, top_ks, top_ps)
+            return jnp.where(active, toks, 0), ok, k, v
 
         B = self.config.max_batch_slots
         mb = self.cache.max_blocks_per_slot
@@ -236,7 +285,8 @@ class ServingEngine:
                       jnp.zeros((B,), bool), self._key,
                       jnp.ones((B,), jnp.float32),
                       jnp.zeros((B,), jnp.int32),
-                      jnp.ones((B,), jnp.float32)))
+                      jnp.ones((B,), jnp.float32),
+                      jnp.zeros((B,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -247,14 +297,16 @@ class ServingEngine:
             return prog
 
         def prefill_fn(params, k, v, table, ids, lens, rng, temps,
-                       top_ks, top_ps):
+                       top_ks, top_ps, poison):
             pos = jnp.zeros((nb,), jnp.int32)
             logits, k, v = self._fwd(params, ids, k, v, table, pos)
             last = jnp.take_along_axis(
                 logits, (lens - 1).astype(jnp.int32)[:, None, None],
                 axis=1)[:, 0, :]
-            toks = sample_tokens(last, rng, temps, top_ks, top_ps)
-            return toks, k, v
+            row = last + poison[:, None]
+            ok = jnp.isfinite(row).all(axis=-1)
+            toks = sample_tokens(row, rng, temps, top_ks, top_ps)
+            return toks, ok, k, v
 
         mb = self.cache.max_blocks_per_slot
         prog = AOTProgram(f"serve_prefill_b{nb}_s{sp}", prefill_fn,
@@ -266,7 +318,8 @@ class ServingEngine:
                       jnp.ones((nb,), jnp.int32), self._key,
                       jnp.ones((nb,), jnp.float32),
                       jnp.zeros((nb,), jnp.int32),
-                      jnp.ones((nb,), jnp.float32)))
+                      jnp.ones((nb,), jnp.float32),
+                      jnp.zeros((nb,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -294,14 +347,80 @@ class ServingEngine:
         if len(lst) > 2 * self.LAT_WINDOW:
             del lst[:len(lst) - self.LAT_WINDOW]
 
+    #: deadline-slack buckets: negatives = finished past deadline (only
+    #: possible within one iteration of it), small positives = tight SLO
+    DEADLINE_SLACK_BUCKETS = (-1.0, -0.1, 0.0, 0.05, 0.1, 0.25, 0.5,
+                              1.0, 2.0, 5.0, 30.0)
+
+    def _requests_counter(self):
+        return get_registry().counter(
+            "serve_requests_total",
+            "serving requests by lifecycle event")
+
+    def _on_request_event(self, outcome: str, st: RequestState) -> None:
+        """Scheduler terminal-transition hook: metrics + forensics.
+        Only fires on lifecycle events — never per step (the
+        zero-overhead pin)."""
+        self._requests_counter().inc(event=outcome)
+        if outcome != "completed":
+            self._flight_event(
+                "request_failed" if outcome == "failed"
+                else f"request_{outcome}",
+                request_id=st.request.request_id,
+                reason=st.failure, tokens=len(st.generated),
+                preemptions=st.preemptions)
+
+    @staticmethod
+    def _flight_enabled() -> bool:
+        return _flight.enabled()
+
+    @staticmethod
+    def _flight_event(name: str, **fields) -> None:
+        _flight.safe_record_event(name, **fields)
+
     # -- request surface ----------------------------------------------------
     def submit(self, request: Request) -> RequestState:
-        st = self.scheduler.submit(request)
-        get_registry().counter(
-            "serve_requests_total",
-            "serving requests by lifecycle event").inc(event="submitted")
+        if self._draining or self._drained:
+            self._requests_counter().inc(event="rejected")
+            raise ServerOverloaded("draining")
+        if self._overload is not None and self._overload.overloaded:
+            # recovery samples normally arrive from step(), but step()
+            # is only driven while there is work — an IDLE engine must
+            # fold the (empty-queue = 0 delay) sample here or a tripped
+            # detector latches forever and sheds all future traffic
+            if not self.scheduler.has_work:
+                transition = self._overload.observe(0.0)
+                if transition is not None:
+                    self._overload_transition(transition)
+            if self._overload.overloaded:
+                self._requests_counter().inc(event="rejected")
+                raise ServerOverloaded(
+                    "overload", queue_depth=self.scheduler.queue_depth,
+                    ewma_s=self._overload.ewma_s,
+                    threshold_s=self._overload.threshold_s)
+        try:
+            st = self.scheduler.submit(request)
+        except ServerOverloaded:
+            # bounded queue refused the newcomer (policy produced no
+            # victim). A never-admitted refusal counts as "rejected";
+            # "shed" is reserved for admitted-then-evicted policy
+            # victims, so offered = submitted + rejected stays exact.
+            self._requests_counter().inc(event="rejected")
+            raise
+        if chaos.active() and chaos.probe("serve.request.poison"):
+            st.poisoned = True
+        self._requests_counter().inc(event="submitted")
         self._publish_gauges()
         return st
+
+    def cancel(self, request_id: int) -> bool:
+        """Client disconnect: cancel a queued request immediately or an
+        in-flight one at the next iteration boundary (its pages are
+        freed there). Returns False for unknown/terminal ids."""
+        hit = self.scheduler.cancel(request_id)
+        if hit:
+            self._publish_gauges()
+        return hit
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 16,
@@ -319,7 +438,9 @@ class ServingEngine:
                 for st in states]
 
     def run(self, max_steps: Optional[int] = None) -> None:
-        """Drive the scheduler until the queue and slots drain."""
+        """Drive the scheduler until the queue and slots drain. Raises
+        :class:`EngineDrained` if a latched drain signal is honoured
+        mid-run."""
         steps = 0
         while self.scheduler.has_work:
             self.step()
@@ -327,18 +448,200 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 return
 
+    # -- graceful drain ------------------------------------------------------
+    def enable_drain(self, snapshot_dir: str,
+                     budget_s: Optional[float] = None,
+                     signals=None) -> DrainLatch:
+        """Install the shutdown latch (PR 5 pattern): SIGTERM (default)
+        is latched by a thin handler and honoured at the next iteration
+        boundary — :meth:`step` then drains and raises
+        :class:`EngineDrained`. Returns the latch (``trigger()`` arms it
+        programmatically; ``shutdown()`` restores the handlers)."""
+        import signal as signal_mod
+        self.config.drain_dir = snapshot_dir
+        if budget_s is not None:
+            self.config.drain_budget_s = float(budget_s)
+        if self._drain_latch is not None:
+            self._drain_latch.close()
+        self._drain_latch = DrainLatch(
+            signals if signals is not None else (signal_mod.SIGTERM,))
+        return self._drain_latch
+
+    def drain(self, snapshot_dir: Optional[str] = None,
+              budget_s: Optional[float] = None) -> DrainReport:
+        """Graceful shutdown: stop admission, keep decoding in-flight
+        sequences up to the drain budget, then snapshot ALL undone work
+        (queued + still-in-flight request specs) through the atomic
+        checkpoint-commit helpers. Zero silently-lost requests: every
+        submitted request either completed or is in the snapshot."""
+        if snapshot_dir is None:
+            snapshot_dir = self.config.drain_dir
+        budget = (self.config.drain_budget_s if budget_s is None
+                  else float(budget_s))
+        self._draining = True            # submit() now sheds
+        sched = self.scheduler
+        completed_before = sched.stats["completed"]
+        deadline = self.clock() + max(0.0, budget)
+        while sched.active() and self.clock() < deadline:
+            try:
+                self.step(admit=False)
+            except DecodeWatchdogError:
+                break                    # hung chip: snapshot what's left
+        # honour latched cancels/expiries before snapshotting: a request
+        # the client disconnected from must end "cancelled", never be
+        # resurrected on the successor engine as "drained" work
+        sched.sweep_active()
+        sched.honour_queued_cancels()
+        specs = [request_spec(st) for _, st in sched.active()]
+        specs += [request_spec(st) for st in sched.waiting]
+        if specs and snapshot_dir is None:
+            self._draining = False
+            raise ValueError(
+                f"drain: {len(specs)} request(s) still pending but no "
+                "snapshot_dir is configured — refusing to discard work "
+                "(pass snapshot_dir or ServingConfig.drain_dir)")
+        path = None
+        if specs:
+            path = save_drain_snapshot(snapshot_dir, specs)
+        for _, st in list(sched.active()):
+            sched.drain_release(st)
+        for st in list(sched.waiting):
+            sched.drain_release(st)
+        completed = sched.stats["completed"] - completed_before
+        self._flight_event("drained", completed=completed,
+                           snapshotted=len(specs), path=path)
+        self._drained = True
+        self._publish_gauges()
+        return DrainReport(completed=completed, snapshotted=len(specs),
+                           path=path)
+
     # -- the serving iteration ----------------------------------------------
-    def step(self) -> bool:
-        """One scheduler iteration: admit+prefill, then one decode
-        dispatch over every active slot. Returns has_work."""
-        for group in self.scheduler.plan_admissions():
-            self._run_prefill(group)
-        if self.scheduler.active():
-            self.scheduler.ensure_decode_capacity()
-            if self.scheduler.active():
+    def step(self, admit: bool = True) -> bool:
+        """One scheduler iteration: honour drain/cancel/deadlines at the
+        boundary, admit+prefill, then one decode dispatch over every
+        active slot. Returns has_work. Raises :class:`EngineDrained`
+        when a latched drain signal was honoured this step."""
+        if self._drain_latch is not None and self._drain_latch.triggered \
+                and not self._draining:
+            raise EngineDrained(self.drain())
+        sched = self.scheduler
+        # iteration-boundary sweeps: queued expiries never touch a slot;
+        # latched cancels / in-flight expiries free pages immediately.
+        # Both are O(0) when no deadline/cancel exists — and never write
+        # the registry except on an actual lifecycle event.
+        sched.expire_queued()
+        sched.sweep_active()
+        if self._overload is not None:
+            oldest_t = sched.oldest_waiting_t()
+            delay = (self.clock() - oldest_t
+                     if oldest_t is not None else 0.0)
+            transition = self._overload.observe(delay)
+            if transition is not None:
+                self._overload_transition(transition)
+        if admit:
+            groups = sched.plan_admissions()
+            for gi, group in enumerate(groups):
+                try:
+                    self._run_prefill(group)
+                except DecodeWatchdogError:
+                    # every not-yet-prefilled state of this plan — the
+                    # tripped group AND any planned after it — holds a
+                    # slot but produced no token; un-admit them all in
+                    # one batch (admission order restored: groups are
+                    # bucketed by length, not arrival) or the retried
+                    # step() would decode slots with nothing to feed
+                    pending = [st for g in groups[gi:] for st in g.states]
+                    pending.sort(key=lambda st: (st.admitted_t,
+                                                 st.request.request_id))
+                    sched.rollback_admission(pending)
+                    raise
+        if sched.active():
+            sched.ensure_decode_capacity()
+            if sched.active():
                 self._run_decode()
         self._publish_gauges()
-        return self.scheduler.has_work
+        return sched.has_work
+
+    def _overload_transition(self, transition: str) -> None:
+        reg = get_registry()
+        on = transition == "enter"
+        reg.gauge("serve_overload",
+                  "1 while the queue-delay overload detector is "
+                  "tripped (new submits are shed)").set(float(on))
+        reg.counter("serve_overload_transitions_total",
+                    "overload detector state changes").inc(
+            state=transition)
+        self._flight_event("overload", state=transition,
+                           ewma_s=round(self._overload.ewma_s, 4),
+                           threshold_s=self._overload.threshold_s,
+                           queue_depth=self.scheduler.queue_depth)
+
+    def _guarded_dispatch(self, kind: str, prog, args,
+                          hang: bool = False):
+        """Run one serving dispatch under the wall-clock watchdog
+        (``FLAGS_serve_watchdog_s``; modeled on the eager-collective
+        watchdog). Flag unset and no chaos hang = direct call, zero
+        overhead. On a trip the hung thread is abandoned and the caller
+        gets a structured :class:`DecodeWatchdogError` plus a
+        flight-recorder dump — never a silent stall."""
+        from ..core.flags import get_flag
+        timeout_s = float(get_flag("serve_watchdog_s") or 0.0)
+        if timeout_s <= 0.0 and not hang:
+            return prog(*args)
+        if hang and timeout_s <= 0.0:
+            raise RuntimeError(
+                "chaos site 'serve.decode.hang' fired but "
+                "FLAGS_serve_watchdog_s is unset — set a watchdog "
+                "budget so the hang can be converted into "
+                "DecodeWatchdogError (the path this site exercises)")
+
+        def job():
+            if hang:
+                # host-side hang BEFORE the dispatch: the program
+                # never runs, so a post-trip retry of the step is
+                # safe (same positions, same K/V writes)
+                chaos.hang_loop(max(timeout_s, 1.0) * 20 + 60.0)
+            return prog(*args)
+
+        # one long-lived dispatcher thread serves every guarded
+        # dispatch; only a trip abandons it (stuck in the hung
+        # program) and costs the next dispatch a fresh worker
+        worker = self._watchdog_worker
+        if worker is None or not worker.usable:
+            worker = DispatchWorker()
+            self._watchdog_worker = worker
+            self._watchdog_threads = [x for x in self._watchdog_threads
+                                      if x.is_alive()]
+            self._watchdog_threads.append(worker.thread)
+        result = worker.dispatch(job, timeout_s)
+        if result is None:
+            n_active = len(self.scheduler.active())
+            # retry soundness: a donating program hands the live pools
+            # to the abandoned dispatch (invalidated on its thread, or
+            # mutated in place by a late zombie finish) — only a
+            # non-donating program leaves the engine state untouched
+            retry_safe = not getattr(prog, "donate_argnums", ())
+            get_registry().counter(
+                "serve_watchdog_trips_total",
+                "serving dispatch watchdog trips").inc(kind=kind)
+            self._flight_event("decode_watchdog", kind=kind,
+                               timeout_s=timeout_s,
+                               dispatch=self._dispatch_seq,
+                               active_slots=n_active,
+                               retry_safe=retry_safe)
+            if self._flight_enabled():
+                try:
+                    _flight.trip_dump(step=self._dispatch_seq,
+                                      reason="serve_watchdog",
+                                      kind=kind, timeout_s=timeout_s)
+                except Exception:
+                    pass          # forensics must not mask the trip
+            raise DecodeWatchdogError(kind, timeout_s,
+                                      self._dispatch_seq, n_active,
+                                      retry_safe=retry_safe)
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
 
     def _sampling_arrays(self, states: Sequence[Optional[RequestState]]):
         n = len(states)
@@ -373,12 +676,18 @@ class ServingEngine:
             self._t_first_work = t0
         prog = self._get_prefill(nb, sp)
         temps, tks, tps = self._sampling_arrays(states)
-        toks, new_k, new_v = prog(
-            self.params, self.cache.k, self.cache.v,
-            self.cache.table_array(rows), jnp.asarray(ids),
-            jnp.asarray(lens), self._next_key(), temps, tks, tps)
+        # a DecodeWatchdogError here propagates to step(), which rolls
+        # back every not-yet-prefilled state of the plan (token-exact:
+        # the tripped dispatch's pool writes died with its thread)
+        toks, ok, new_k, new_v = self._guarded_dispatch(
+            "prefill", prog,
+            (self.params, self.cache.k, self.cache.v,
+             self.cache.table_array(rows), jnp.asarray(ids),
+             jnp.asarray(lens), self._next_key(), temps, tks, tps,
+             self._poison_array(states)))
         self.cache.update(new_k, new_v)
         toks = np.asarray(toks)
+        ok = np.asarray(ok)
         now = self.clock()
         self._stats["prefill_dispatches"] += 1
         reg = get_registry()
@@ -388,7 +697,19 @@ class ServingEngine:
         for i, st in enumerate(states):
             if st is None:
                 continue
+            if not ok[i]:
+                self.scheduler.fail(st, "non-finite logits at prefill")
+                continue
             self._accept_token(st, int(toks[i]), now)
+
+    def _poison_array(self, states: Sequence[Optional[RequestState]]):
+        """[n] f32 additive logits poison: all zeros (bit-transparent)
+        unless chaos marked a request, whose row turns NaN."""
+        poison = np.zeros((len(states),), np.float32)
+        for i, st in enumerate(states):
+            if st is not None and st.poisoned:
+                poison[i] = np.nan
+        return jnp.asarray(poison)
 
     def _run_decode(self) -> None:
         B = self.config.max_batch_slots
@@ -408,13 +729,17 @@ class ServingEngine:
         t0 = self.clock()
         prog = self._get_decode()
         temps, tks, tps = self._sampling_arrays(per_slot)
-        toks, new_k, new_v = prog(
-            self.params, self.cache.k, self.cache.v,
-            self.cache.table_array(), jnp.asarray(pos),
-            jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
-            temps, tks, tps)
+        hang = chaos.active() and chaos.probe("serve.decode.hang")
+        toks, ok, new_k, new_v = self._guarded_dispatch(
+            "decode", prog,
+            (self.params, self.cache.k, self.cache.v,
+             self.cache.table_array(), jnp.asarray(pos),
+             jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
+             temps, tks, tps, self._poison_array(per_slot)),
+            hang=hang)
         self.cache.update(new_k, new_v)
         toks = np.asarray(toks)
+        ok = np.asarray(ok)
         now = self.clock()
         dt = now - t0
         st_ = self._stats
@@ -429,6 +754,9 @@ class ServingEngine:
                       "active slots per decode dispatch",
                       buckets=tuple(range(1, B + 1))).observe(n_active)
         for slot, st in list(self.scheduler.active()):
+            if not ok[slot]:
+                self.scheduler.fail(st, "non-finite logits at decode")
+                continue
             self._accept_token(st, int(toks[slot]), now)
 
     def _accept_token(self, st: RequestState, token: int,
@@ -448,12 +776,24 @@ class ServingEngine:
             "serve_tokens_generated_total",
             "tokens sampled across all requests").inc()
         req = st.request
-        if req.on_token is not None:
-            text = None
-            if self.config.detokenizer is not None:
-                text = self.config.detokenizer.piece(
-                    token, is_first=len(st.generated) == 1)
-            req.on_token(req, token, text)
+        try:
+            if chaos.active() and chaos.probe("serve.detok.raise"):
+                raise chaos.ChaosFault("serve.detok.raise")
+            if req.on_token is not None:
+                text = None
+                if self.config.detokenizer is not None:
+                    text = self.config.detokenizer.piece(
+                        token, is_first=len(st.generated) == 1)
+                req.on_token(req, token, text)
+            if req.stop is not None and req.stop(list(st.generated)):
+                st.stop_hit = True
+        except Exception as e:
+            # fault isolation: a raising detokenizer / client callback /
+            # malformed stop condition fails ONLY this request — the
+            # rest of the batch streams on
+            self.scheduler.fail(
+                st, f"detokenizer/callback error: {e!r}")
+            return
         if st.is_done():
             self.scheduler.finish(st)
             e2e = now - st.submitted_t
@@ -469,9 +809,13 @@ class ServingEngine:
             reg = get_registry()
             reg.histogram("serve_e2e_seconds",
                           "submit -> completion latency").observe(e2e)
-            reg.counter("serve_requests_total",
-                        "serving requests by lifecycle event"
-                        ).inc(event="completed")
+            if st.deadline_t is not None:
+                reg.histogram(
+                    "serve_deadline_slack_seconds",
+                    "deadline minus completion time for deadline-"
+                    "carrying requests (negative = finished late)",
+                    buckets=self.DEADLINE_SLACK_BUCKETS).observe(
+                    st.deadline_t - now)
 
     def _publish_gauges(self) -> None:
         reg = get_registry()
@@ -507,8 +851,16 @@ class ServingEngine:
                 self._t_last_token is not None:
             elapsed = max(self._t_last_token - self._t_first_work, 1e-9)
         lat = self._lat
+        sstats = self.scheduler.stats
         return {
-            "requests_completed": self.scheduler.stats["completed"],
+            "requests_completed": sstats["completed"],
+            "requests_submitted": sstats["submitted"],
+            "requests_expired": sstats["expired"],
+            "requests_expired_queued": sstats["expired_queued"],
+            "requests_shed": sstats["shed"],
+            "requests_cancelled": sstats["cancelled"],
+            "requests_failed": sstats["failed"],
+            "requests_drained": sstats["drained"],
             "preemptions": self.scheduler.stats["preemptions"],
             "tokens_generated": self._stats["tokens_generated"],
             "elapsed_s": elapsed,
@@ -528,8 +880,26 @@ class ServingEngine:
         }
 
     def shutdown(self) -> None:
-        """Drop compiled programs and cache pools (test isolation /
-        explicit teardown)."""
+        """Drop compiled programs, cache pools, the drain latch (signal
+        handlers restored) and any live watchdog threads (test isolation
+        / explicit teardown)."""
+        if self._drain_latch is not None:
+            self._drain_latch.close()
+            self._drain_latch = None
+        if self._watchdog_worker is not None:
+            self._watchdog_worker.close()
+            self._watchdog_worker = None
+        if self._watchdog_threads:
+            # a thread abandoned in a chaos hang exits as soon as the
+            # hang is cancelled; one stuck in a real dispatch is daemon
+            # and joins best-effort
+            chaos.cancel_hangs()
+            for t in self._watchdog_threads:
+                t.join(timeout=0.5)
+            self._watchdog_threads = []
+            # this engine's teardown must not neutralize still-armed
+            # hang sites for other live engines
+            chaos.rearm_hangs()
         self._programs.clear()
         self.scheduler.waiting.clear()
         for slot, _ in list(self.scheduler.active()):

@@ -1,17 +1,38 @@
 """Synthetic open-loop load generator for the serving engine.
 
-Open-loop means arrivals follow a FIXED schedule (Poisson process at
-``rate_rps``) regardless of how fast the engine drains — the honest way
-to measure serving latency: a closed-loop driver (next request only
-after the previous completes) hides queueing delay exactly when the
-system saturates. Prompt and generation lengths are drawn per request
-from uniform ranges; everything is seeded, so a load run replays
-exactly (the same property the chaos harness pins for faults).
+Open-loop means arrivals follow a FIXED schedule regardless of how fast
+the engine drains — the honest way to measure serving latency: a
+closed-loop driver (next request only after the previous completes)
+hides queueing delay exactly when the system saturates. Everything is
+seeded, so a load run replays exactly (the same property the chaos
+harness pins for faults).
 
-``run_open_loop`` drives the engine inline: it submits every request
-whose arrival time has passed, then runs one engine step, until the
-schedule is exhausted and the engine drains. ``time_scale`` compresses
-the schedule for tests (arrivals only — measured latencies are real).
+Arrival processes (``LoadSpec.arrival``):
+
+- ``poisson`` — exponential inter-arrival gaps at ``rate_rps`` (the
+  classic memoryless open-loop load);
+- ``gamma`` — Gamma-distributed gaps with the SAME mean rate but
+  squared coefficient of variation ``burstiness`` (the shape parameter
+  is ``1/burstiness``: > 1 clumps arrivals into bursts, < 1 produces
+  smoother-than-poisson pacing);
+- ``mmpp`` — a 2-state Markov-modulated Poisson process: a hidden state
+  flips between a hot rate ``rate*(1+burstiness)`` and a cold rate
+  ``rate/(1+burstiness)`` with probability ``mmpp_switch`` per arrival,
+  gaps rescaled so the mean rate is still ``rate_rps`` — sustained
+  overload episodes followed by idle valleys, the arrival shape that
+  actually exercises shedding and the overload detector.
+
+Per-request ``deadline_range`` / ``priority_choices`` sampling makes the
+expiry and priority-lane paths reachable from ``bench.py --serve``. The
+extra draws only happen when the corresponding field is set, so default
+specs generate byte-identical traffic to the pre-resilience generator.
+
+:class:`TokenBucket` is client-side rate limiting for loadgen-driven
+tests: ``run_open_loop(..., token_bucket=...)`` drops (counts) arrivals
+that exceed the bucket instead of submitting them. Server-side shedding
+(:class:`~.resilience.ServerOverloaded`) is likewise counted, not
+crashed on — an overloaded server answering "no" is the behaviour under
+test, not an error in the driver.
 """
 
 from __future__ import annotations
@@ -22,10 +43,18 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .resilience import DecodeWatchdogError, ServerOverloaded
 from .sampling import SamplingParams
 from .scheduler import Request
 
-__all__ = ["LoadSpec", "build_requests", "run_open_loop"]
+__all__ = ["LoadSpec", "TokenBucket", "build_requests", "run_open_loop"]
+
+_ARRIVALS = ("poisson", "gamma", "mmpp")
+
+#: run_open_loop gives up (re-raises) after this many watchdog trips in
+#: a row with no successful step between them: a backend that hangs on
+#: EVERY retry is down, not slow
+MAX_CONSECUTIVE_WATCHDOG_TRIPS = 8
 
 
 @dataclass
@@ -37,16 +66,85 @@ class LoadSpec:
     vocab_size: int = 50304
     seed: int = 0
     sampling: Optional[SamplingParams] = None
+    #: arrival process: poisson | gamma | mmpp (see module docstring)
+    arrival: str = "poisson"
+    #: gamma: squared CV of the gaps; mmpp: hot/cold rate swing. 1.0
+    #: with gamma degenerates to poisson.
+    burstiness: float = 1.0
+    #: mmpp: per-arrival probability of flipping the hidden rate state
+    mmpp_switch: float = 0.1
+    #: uniform per-request deadline_s sample; None = no deadlines
+    deadline_range: Optional[Tuple[float, float]] = None
+    #: uniform per-request priority sample; None = all priority 0
+    priority_choices: Optional[Tuple[int, ...]] = None
+
+
+class TokenBucket:
+    """Deterministic client-side rate limiter: ``rate`` tokens/s refill
+    up to a ``burst`` cap; :meth:`admit` spends one token or answers
+    False. Driven by the caller's clock values, so tests replay
+    exactly."""
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst < 1:
+            raise ValueError("token bucket needs rate > 0 and burst >= 1")
+        self.rate = float(rate)
+        self.capacity = float(burst)
+        self.tokens = float(burst)
+        self._last: Optional[float] = None
+
+    def admit(self, now: float) -> bool:
+        if self._last is not None:
+            self.tokens = min(self.capacity,
+                              self.tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+def _arrival_gaps(spec: LoadSpec, rng) -> np.ndarray:
+    """Inter-arrival gaps (seconds) for ``num_requests`` arrivals, mean
+    rate ``rate_rps`` for every mode."""
+    if spec.arrival not in _ARRIVALS:
+        raise ValueError(f"unknown arrival mode {spec.arrival!r}; one "
+                         f"of {_ARRIVALS}")
+    mean = 1.0 / max(spec.rate_rps, 1e-9)
+    n = spec.num_requests
+    if spec.arrival == "gamma" and spec.burstiness <= 0.0:
+        raise ValueError("gamma arrival needs burstiness > 0 "
+                         "(= the squared CV of the gaps)")
+    if spec.arrival == "poisson" or \
+            (spec.arrival == "gamma" and spec.burstiness == 1.0):
+        return rng.exponential(mean, n)
+    if spec.arrival == "gamma":
+        # CV^2 = burstiness: > 1 clumps arrivals, < 1 smooths them
+        # (shape > 1, more regular than poisson) — both valid loads
+        shape = 1.0 / float(spec.burstiness)
+        return rng.gamma(shape, mean / shape, n)
+    # mmpp: hidden 2-state rate, switched per arrival
+    swing = 1.0 + max(float(spec.burstiness), 0.0)
+    rates = (spec.rate_rps * swing, spec.rate_rps / swing)
+    state = 0
+    gaps = np.empty((n,), np.float64)
+    for i in range(n):
+        gaps[i] = rng.exponential(1.0 / max(rates[state], 1e-9))
+        if rng.random() < spec.mmpp_switch:
+            state = 1 - state
+    # symmetric switching -> stationary occupancy 1/2 per state, so the
+    # raw expected gap is (1/swing + swing)/(2*rate); rescale to keep
+    # the promised mean rate exactly (offered_rate_rps stays honest)
+    gaps *= 2.0 / (swing + 1.0 / swing)
+    return gaps
 
 
 def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
-    """[(arrival_offset_s, Request), ...] sorted by arrival. Poisson
-    arrivals (exponential gaps at ``rate_rps``), uniform prompt/output
-    lengths, uniform random token ids — deterministic per seed."""
+    """[(arrival_offset_s, Request), ...] sorted by arrival — the chosen
+    arrival process, uniform prompt/output lengths, uniform random token
+    ids, optional deadline/priority sampling — deterministic per seed."""
     rng = np.random.default_rng(spec.seed)
-    gaps = rng.exponential(1.0 / max(spec.rate_rps, 1e-9),
-                           spec.num_requests)
-    arrivals = np.cumsum(gaps)
+    arrivals = np.cumsum(_arrival_gaps(spec, rng))
     arrivals[0] = 0.0                       # first request at t=0
     out = []
     lo_p, hi_p = spec.prompt_len_range
@@ -54,28 +152,64 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
     for i in range(spec.num_requests):
         plen = int(rng.integers(lo_p, hi_p + 1))
         prompt = rng.integers(0, spec.vocab_size, (plen,)).astype(np.int32)
+        deadline = None
+        if spec.deadline_range is not None:
+            lo_d, hi_d = spec.deadline_range
+            deadline = float(rng.uniform(lo_d, hi_d))
+        priority = 0
+        if spec.priority_choices:
+            priority = int(spec.priority_choices[
+                int(rng.integers(0, len(spec.priority_choices)))])
         out.append((float(arrivals[i]), Request(
             prompt,
             max_new_tokens=int(rng.integers(lo_n, hi_n + 1)),
-            sampling=spec.sampling or SamplingParams())))
+            sampling=spec.sampling or SamplingParams(),
+            deadline_s=deadline, priority=priority)))
     return out
 
 
 def run_open_loop(engine, spec: LoadSpec, time_scale: float = 1.0,
-                  clock=time.perf_counter) -> dict:
+                  clock=time.perf_counter,
+                  token_bucket: Optional[TokenBucket] = None) -> dict:
     """Drive ``engine`` through the schedule; returns
-    ``engine.metrics_summary()`` augmented with offered load."""
+    ``engine.metrics_summary()`` augmented with offered load and the
+    client-visible refusal counts. Server-side shedding
+    (:class:`ServerOverloaded`) and watchdog trips
+    (:class:`DecodeWatchdogError`) are COUNTED and survived — overload
+    behaviour is what this driver exists to measure."""
     schedule = build_requests(spec)
     t0 = clock()
     i = 0
+    rejected = throttled = watchdog_trips = 0
+    consecutive_trips = 0
     while i < len(schedule) or engine.scheduler.has_work:
         now = clock() - t0
         while i < len(schedule) and \
                 schedule[i][0] * time_scale <= now:
-            engine.submit(schedule[i][1])
+            if token_bucket is not None and \
+                    not token_bucket.admit(now):
+                throttled += 1
+            else:
+                try:
+                    engine.submit(schedule[i][1])
+                except ServerOverloaded:
+                    rejected += 1
             i += 1
         if engine.scheduler.has_work:
-            engine.step()
+            try:
+                engine.step()
+                consecutive_trips = 0
+            except DecodeWatchdogError as e:
+                # hung dispatch converted to a structured error: count
+                # it and retry the step (token-exact for greedy) — but
+                # a PERSISTENTLY hung backend must not become an
+                # infinite retry loop that piles up abandoned threads,
+                # and a trip that lost donated pools cannot retry at all
+                watchdog_trips += 1
+                consecutive_trips += 1
+                if not e.retry_safe \
+                        or consecutive_trips >= MAX_CONSECUTIVE_WATCHDOG_TRIPS:
+                    raise
         elif i < len(schedule):
             # idle gap before the next arrival: sleep the remainder
             wait = schedule[i][0] * time_scale - (clock() - t0)
@@ -84,4 +218,7 @@ def run_open_loop(engine, spec: LoadSpec, time_scale: float = 1.0,
     summary = engine.metrics_summary()
     summary["offered_rate_rps"] = spec.rate_rps / max(time_scale, 1e-9)
     summary["num_requests"] = spec.num_requests
+    summary["requests_rejected"] = rejected
+    summary["requests_throttled"] = throttled
+    summary["watchdog_trips"] = watchdog_trips
     return summary

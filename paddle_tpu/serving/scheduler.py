@@ -34,11 +34,21 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..testing import chaos
 from .kv_cache import PagedKVCache
+from .resilience import ServerOverloaded
 from .sampling import SamplingParams
 
 __all__ = ["Request", "RequestState", "BucketTable", "Scheduler",
-           "AdmissionGroup"]
+           "AdmissionGroup", "QUEUE_POLICIES", "TERMINAL_OUTCOMES"]
+
+#: bounded-queue shedding policies (ServingConfig.queue_policy)
+QUEUE_POLICIES = ("reject-new", "drop-oldest", "priority")
+
+#: every request ends in exactly one of these (the fuzz test pins the
+#: exclusivity); "completed" is the only success
+TERMINAL_OUTCOMES = ("completed", "expired", "shed", "cancelled",
+                     "failed", "drained")
 
 _request_ids = itertools.count()
 
@@ -56,6 +66,15 @@ class Request:
     the decode step it is produced (``text`` is None unless the engine
     has a detokenizer). ``eos_token_id`` ends the stream early; the eos
     token itself is reported and included in the output.
+
+    ``deadline_s`` is a time-to-live from submission: a queued request
+    past its deadline expires before it ever touches a slot; an
+    in-flight one is cancelled at the next iteration boundary and its
+    pages freed immediately. ``priority`` feeds the ``priority`` queue
+    policy (higher = more important; ties stay FIFO). ``stop`` is an
+    optional custom stop condition ``stop(generated_ids) -> bool``
+    evaluated after every accepted token; a raising (malformed) stop
+    condition fails ONLY its own request.
     """
 
     prompt: Sequence[int]
@@ -63,6 +82,9 @@ class Request:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     eos_token_id: Optional[int] = None
     on_token: Optional[Callable] = None
+    deadline_s: Optional[float] = None
+    priority: int = 0
+    stop: Optional[Callable] = None
     request_id: int = field(default_factory=lambda: next(_request_ids))
 
     def __post_init__(self):
@@ -71,6 +93,8 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (None = no deadline)")
 
 
 class RequestState:
@@ -87,6 +111,24 @@ class RequestState:
         self.finished_t: Optional[float] = None
         self.preemptions = 0
         self.finished = False
+        #: exactly one TERMINAL_OUTCOMES value once the request ends
+        self.outcome: Optional[str] = None
+        #: human-readable reason for outcome == "failed"
+        self.failure: Optional[str] = None
+        #: absolute wall deadline (scheduler clock domain)
+        self.deadline_t: Optional[float] = (
+            now + request.deadline_s if request.deadline_s is not None
+            else None)
+        #: client disconnect latched; honoured at the iteration boundary
+        self.cancel_requested = False
+        #: custom stop condition returned True (engine-evaluated)
+        self.stop_hit = False
+        #: chaos serve.request.poison marked this request
+        self.poisoned = False
+
+    @property
+    def terminal(self) -> bool:
+        return self.outcome is not None
 
     @property
     def seq_len(self) -> int:
@@ -107,6 +149,8 @@ class RequestState:
         return self.request.max_new_tokens - len(self.generated)
 
     def is_done(self) -> bool:
+        if self.stop_hit:
+            return True
         if len(self.generated) >= self.request.max_new_tokens:
             return True
         eos = self.request.eos_token_id
@@ -172,7 +216,9 @@ class Scheduler:
 
     def __init__(self, cache: PagedKVCache, buckets: BucketTable,
                  max_queue: int = 1024, clock=time.perf_counter,
-                 max_seq_len: Optional[int] = None):
+                 max_seq_len: Optional[int] = None,
+                 policy: str = "reject-new",
+                 on_event: Optional[Callable] = None):
         self.cache = cache
         self.buckets = buckets
         # the admission limit is the CONFIGURED context window (position
@@ -181,17 +227,62 @@ class Scheduler:
         self.max_seq_len = int(max_seq_len if max_seq_len is not None
                                else cache.max_context_len)
         self.max_queue = int(max_queue)
+        if policy not in QUEUE_POLICIES:
+            raise ValueError(f"unknown queue policy {policy!r}; one of "
+                             f"{QUEUE_POLICIES}")
+        self.policy = policy
+        #: ``on_event(outcome, state)`` fires on every terminal
+        #: transition — the engine's metrics/flight hook. The scheduler
+        #: itself never writes the registry (the zero-overhead pin).
+        self.on_event = on_event
         self.clock = clock
         self.waiting: List[RequestState] = []
         self.slots: List[Optional[RequestState]] = \
             [None] * cache.max_slots
         self.stats = {"submitted": 0, "completed": 0, "preemptions": 0,
-                      "admitted": 0}
+                      "admitted": 0, "expired": 0, "expired_queued": 0,
+                      "shed": 0, "cancelled": 0, "failed": 0,
+                      "drained": 0}
+        # deadline sweeps stay O(0) until the first deadline-carrying
+        # request ever arrives
+        self._saw_deadline = False
+
+    # -- terminal transitions ----------------------------------------------
+    def _terminate(self, st: RequestState, outcome: str,
+                   reason: Optional[str] = None) -> None:
+        """The ONE exit path: frees any held slot/pages, stamps exactly
+        one outcome, updates stats and fires ``on_event``."""
+        assert st.outcome is None, \
+            f"request {st.request.request_id} already {st.outcome}"
+        if st.slot is not None:
+            self.cache.free_slot(st.slot)
+            self.slots[st.slot] = None
+            st.slot = None
+        st.outcome = outcome
+        st.failure = reason
+        st.finished = outcome == "completed"
+        st.finished_t = self.clock()
+        self.stats[outcome] += 1
+        if self.on_event is not None:
+            self.on_event(outcome, st)
+
+    def _shed_victim(self, request: Request) -> Optional[RequestState]:
+        """Who leaves the full queue so ``request`` can enter (None =
+        nobody; reject the newcomer)."""
+        if self.policy == "drop-oldest":
+            return self.waiting[0] if self.waiting else None
+        if self.policy == "priority":
+            # lowest priority first, oldest within the class — and only
+            # when the newcomer actually outranks it
+            victim = min(self.waiting, default=None,
+                         key=lambda s: s.request.priority)
+            if victim is not None \
+                    and victim.request.priority < request.priority:
+                return victim
+        return None
 
     # -- queue --------------------------------------------------------------
     def submit(self, request: Request) -> RequestState:
-        if len(self.waiting) >= self.max_queue:
-            raise RuntimeError(f"request queue full ({self.max_queue})")
         if request.prompt.size + request.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt ({request.prompt.size}) + max_new_tokens "
@@ -213,14 +304,123 @@ class Scheduler:
         # after a worst-case preemption (prompt + all generated tokens)
         self.buckets.len_bucket(
             request.prompt.size + request.max_new_tokens - 1)
+        # queue-full policy runs AFTER validation: an invalid request
+        # must never shed a valid waiter on its way to a ValueError.
+        # Sweep already-expired waiters first (O(0) without deadlines):
+        # a dead request must not hold capacity against a live submit,
+        # nor get mis-terminated as "shed" when it in fact expired.
+        self.expire_queued()
+        if len(self.waiting) >= self.max_queue:
+            victim = self._shed_victim(request)
+            if victim is None:
+                raise ServerOverloaded("queue_full",
+                                       queue_depth=len(self.waiting))
+            self.waiting.remove(victim)
+            self._terminate(victim, "shed")
         st = RequestState(request, self.clock())
-        self.waiting.append(st)
+        if st.deadline_t is not None:
+            self._saw_deadline = True
+        if self.policy == "priority":
+            # priority lanes: insert behind the last peer of >= priority
+            idx = next((i for i, w in enumerate(self.waiting)
+                        if w.request.priority < request.priority),
+                       len(self.waiting))
+            self.waiting.insert(idx, st)
+        else:
+            self.waiting.append(st)
         self.stats["submitted"] += 1
         return st
+
+    def cancel(self, request_id: int) -> bool:
+        """Client disconnect: a queued request is cancelled on the spot;
+        an in-flight one is latched and cancelled at the next iteration
+        boundary (``sweep_active``), freeing its pages immediately then.
+        False when the id is unknown or already terminal."""
+        for st in self.waiting:
+            if st.request.request_id == request_id:
+                self.waiting.remove(st)
+                self._terminate(st, "cancelled")
+                return True
+        for _, st in self.active():
+            if st.request.request_id == request_id:
+                st.cancel_requested = True
+                return True
+        return False
+
+    def expire_queued(self) -> List[RequestState]:
+        """Drop queued requests past their deadline — BEFORE they ever
+        touch a slot (no prefill, no pages, no wasted decode work).
+        O(0) until the first deadline-carrying request exists."""
+        if not self._saw_deadline or not self.waiting:
+            return []
+        now = self.clock()
+        out = []
+        for st in [w for w in self.waiting
+                   if w.deadline_t is not None and now >= w.deadline_t]:
+            self.waiting.remove(st)
+            self._terminate(st, "expired")
+            # queued expiries never cost the engine any work — shed-rate
+            # accounting treats them like admission drops, unlike an
+            # in-flight expiry (admitted, decoded, then ran out of time)
+            self.stats["expired_queued"] += 1
+            out.append(st)
+        return out
+
+    def sweep_active(self) -> List[RequestState]:
+        """Iteration-boundary sweep over the slots: honour latched
+        cancellations and expire in-flight requests past their deadline,
+        freeing their pages immediately."""
+        out = []
+        for _, st in list(self.active()):
+            if st.cancel_requested:
+                self._terminate(st, "cancelled")
+                out.append(st)
+            elif st.deadline_t is not None \
+                    and self.clock() >= st.deadline_t:
+                self._terminate(st, "expired")
+                out.append(st)
+        return out
+
+    def honour_queued_cancels(self) -> List[RequestState]:
+        """Terminate waiting requests whose in-flight cancel was latched
+        before a preemption put them back in the queue. Admission honours
+        the latch lazily (:meth:`plan_admissions`); drain calls this
+        eagerly so a disconnected client's work is never snapshotted."""
+        out = []
+        for st in [w for w in self.waiting if w.cancel_requested]:
+            self.waiting.remove(st)
+            self._terminate(st, "cancelled")
+            out.append(st)
+        return out
+
+    def fail(self, st: RequestState, reason: str) -> None:
+        """Fault isolation: a poisoned request fails ALONE (its slot and
+        pages are released; the rest of the batch streams on)."""
+        self._terminate(st, "failed", reason=reason)
+
+    def drain_release(self, st: RequestState) -> None:
+        """Graceful drain: release the request (queued or in-flight)
+        with outcome ``drained`` — its undone work goes to the snapshot,
+        nothing is silently lost."""
+        if st in self.waiting:
+            self.waiting.remove(st)
+        self._terminate(st, "drained")
 
     @property
     def queue_depth(self) -> int:
         return len(self.waiting)
+
+    def oldest_waiting_t(self) -> Optional[float]:
+        """``submitted_t`` of the oldest waiter, or None when the queue
+        is empty. Under the ``priority`` policy the queue is lane-ordered
+        (not FIFO), so the oldest waiter — the one the overload detector
+        must see, or starving low-priority requests can age unboundedly
+        without ever tripping it — is not necessarily ``waiting[0]``."""
+        if not self.waiting:
+            return None
+        if self.policy == "priority":
+            return min(st.submitted_t for st in self.waiting)
+        return self.waiting[0].submitted_t
 
     def active(self) -> List[Tuple[int, RequestState]]:
         return [(i, st) for i, st in enumerate(self.slots)
@@ -239,8 +439,17 @@ class Scheduler:
         returned group is guaranteed runnable."""
         admitted: List[Tuple[int, RequestState]] = []
         free_slots = [i for i, st in enumerate(self.slots) if st is None]
+        if self.waiting and free_slots and chaos.active() \
+                and chaos.probe("serve.pages.exhaust"):
+            return []                  # injected dry pool: admission waits
         while self.waiting and free_slots:
             st = self.waiting[0]
+            if st.cancel_requested:
+                # a latched in-flight cancel survives preemption back to
+                # the queue: honour it here, never waste a prefill on it
+                self.waiting.pop(0)
+                self._terminate(st, "cancelled")
+                continue
             slot = free_slots[0]
             if not self.cache.alloc_slot(slot, st.effective_prompt().size):
                 break                      # page pool dry: FIFO blocks
@@ -273,6 +482,18 @@ class Scheduler:
         the older ones fit. Returns the preempted states (already
         requeued at the queue front)."""
         preempted: List[RequestState] = []
+        if len(self.active()) >= 2 and chaos.active() \
+                and chaos.probe("serve.pages.exhaust"):
+            # injected pool pressure: recompute-preempt the newest
+            # admitted request (token-identical continuation for greedy)
+            # — the same victim order as the real dry-pool path below;
+            # the oldest is excluded so the batch always keeps progress
+            oldest = min(self.active(),
+                         key=lambda p: p[1].admitted_t)[1]
+            victim = self._newest_active(exclude=oldest)
+            if victim is not None:
+                self._preempt(victim)
+                preempted.append(victim)
         # oldest-first: earlier-admitted requests keep their pages
         order = sorted(self.active(), key=lambda p: p[1].admitted_t)
         for slot, st in order:
@@ -300,22 +521,39 @@ class Scheduler:
             return None
         return max(cands, key=lambda s: s.admitted_t)
 
-    def _preempt(self, st: RequestState) -> None:
+    def _preempt(self, st: RequestState, count: bool = True) -> None:
         assert st.slot is not None
         self.cache.free_slot(st.slot)
         self.slots[st.slot] = None
         st.slot = None
         st.admitted_t = None
-        st.preemptions += 1
-        self.stats["preemptions"] += 1
-        self.waiting.insert(0, st)             # reclaims FIFO priority
+        if count:
+            st.preemptions += 1
+            self.stats["preemptions"] += 1
+        if self.policy == "priority":
+            # front of its priority class (ahead of equal-priority
+            # waiters: it already held a slot once)
+            idx = next((i for i, w in enumerate(self.waiting)
+                        if w.request.priority <= st.request.priority),
+                       len(self.waiting))
+            self.waiting.insert(idx, st)
+        else:
+            self.waiting.insert(0, st)         # reclaims FIFO priority
+
+    def rollback_admission(self, sts: Sequence[RequestState]) -> None:
+        """Un-admit freshly admitted states whose prefill never produced
+        a token (watchdog trip abandoned the dispatch): back to the
+        queue front, pages freed, so a retried ``step()`` re-plans the
+        admission and re-prefills instead of decoding slots that have no
+        generated token to feed. Reversed so FIFO order survives the
+        one-at-a-time front inserts. Not counted as a preemption — the
+        page-pressure telemetry must not read watchdog incidents as a
+        dry KV pool."""
+        for st in reversed(list(sts)):
+            if st.slot is not None and self.slots[st.slot] is st:
+                self._preempt(st, count=False)
 
     # -- completion ---------------------------------------------------------
     def finish(self, st: RequestState) -> None:
         assert st.slot is not None
-        self.cache.free_slot(st.slot)
-        self.slots[st.slot] = None
-        st.slot = None
-        st.finished = True
-        st.finished_t = self.clock()
-        self.stats["completed"] += 1
+        self._terminate(st, "completed")

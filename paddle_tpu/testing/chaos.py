@@ -57,7 +57,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["SITES", "ChaosFault", "register_site", "configure", "arm",
            "active", "probe", "fired", "occurrences", "reset",
-           "hang_loop", "chaos_scope"]
+           "cancel_hangs", "rearm_hangs", "hang_loop", "chaos_scope"]
 
 # site -> one-line description (the registry doubles as typo protection:
 # arming or probing an unknown site is a bug in the caller, not a fault)
@@ -70,6 +70,21 @@ SITES: Dict[str, str] = {
                        "cancellable sleep)",
     "grad.nonfinite": "replace the TrainStep loss with NaN",
     "worker.die": "kill the training loop at a step boundary",
+    # serving sites (ISSUE 8; probed by paddle_tpu.serving — built in so
+    # `bench.py --chaos` can arm them before the serving import)
+    "serve.decode.hang": "block a serving decode dispatch (bounded, "
+                         "cancellable sleep) — the FLAGS_serve_watchdog_s "
+                         "watchdog must convert it into "
+                         "DecodeWatchdogError",
+    "serve.request.poison": "poison a submitted request: its sampled "
+                            "logits row turns non-finite, so fault "
+                            "isolation must fail ONLY that slot",
+    "serve.pages.exhaust": "pretend the KV page pool ran dry for one "
+                           "scheduler decision: admission waits / the "
+                           "newest-admitted request is recompute-"
+                           "preempted",
+    "serve.detok.raise": "raise from the streaming detokenizer/on_token "
+                         "callback of one accepted token",
 }
 
 
@@ -254,6 +269,23 @@ def fired() -> List[Tuple[str, int]]:
 def occurrences(site: str) -> int:
     """How many times ``site`` was probed while armed."""
     return _state._counts.get(site, 0)
+
+
+def cancel_hangs() -> None:
+    """Cancel in-flight :func:`hang_loop` sleeps WITHOUT disarming the
+    plans (engine/watchdog teardown: abandoned hung worker threads must
+    exit promptly even before the test-scope chaos reset runs).
+    Subsequent hangs in this arming no-op until :func:`reset` or
+    :func:`rearm_hangs`."""
+    _state._cancel.set()
+
+
+def rearm_hangs() -> None:
+    """Re-enable hang sites after :func:`cancel_hangs` (one engine's
+    shutdown must not neutralize still-armed chaos for other live
+    engines). Threads blocked on the old cancel event still exit; new
+    :func:`hang_loop` calls honour fresh cancels."""
+    _state._cancel = threading.Event()
 
 
 def reset() -> None:

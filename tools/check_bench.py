@@ -31,6 +31,12 @@ DEFAULT_TOLERANCE = 0.10
 # time)
 _TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
                "mib", "gib"}
+# bounded 0-100 cost rates (growth is the regression) gate on ABSOLUTE
+# percentage points: the healthy baseline is 0, where a relative ratio
+# is undefined and the v_old==0 skip would otherwise make the metric
+# ungateable ("%" alone stays rate-like and relative:
+# serve_availability_pct regresses when it shrinks)
+_ABS_POINT_UNITS = {"shed%"}
 
 
 def _metric_list(record) -> List[dict]:
@@ -80,9 +86,17 @@ def compare(old: List[dict], new: List[dict],
             problems.append(f"{name}: malformed value "
                             f"({m.get('value')!r} vs {ref.get('value')!r})")
             continue
+        unit = str(m.get("unit", ref.get("unit", "")))
+        if unit.strip().lower() in _ABS_POINT_UNITS:
+            delta = v_new - v_old             # growth is the regression
+            if delta > tolerance * 100.0:
+                problems.append(
+                    f"{name}: {v_old:g} -> {v_new:g} {unit} "
+                    f"(+{delta:.1f} points, tolerance "
+                    f"{tolerance * 100:.0f} points)")
+            continue
         if v_old == 0:
             continue
-        unit = str(m.get("unit", ref.get("unit", "")))
         if lower_is_better(unit):
             ratio = v_new / v_old         # >1 means slower
             if ratio > 1 + tolerance:

@@ -136,9 +136,78 @@ def _hist_pct(row: dict, q: float) -> Optional[float]:
     return None
 
 
-def _serve_section(latest, used) -> List[str]:
-    """--serve: per-request latency histograms + queue/occupancy gauges
-    from the serving engine's registry stream (docs/SERVING.md)."""
+#: canonical request-outcome order for the --serve table: offered
+#: traffic first (submitted + never-admitted rejections), then the
+#: terminal outcomes per paddle_tpu.serving.scheduler.TERMINAL_OUTCOMES
+_OUTCOME_ORDER = ("submitted", "rejected", "completed", "expired",
+                  "shed", "cancelled", "failed", "drained")
+
+
+def _serve_outcomes(latest, used) -> List[str]:
+    """Request-outcome table from serve_requests_total{event=...}: where
+    every request ended up (zero-lost accounting — docs/SERVING.md,
+    "Operating under overload and failure"). Terminal outcomes are a
+    share of SUBMITTED requests; "rejected" (refused at admission,
+    never submitted) is a share of OFFERED = submitted + rejected."""
+    counts = {}
+    for key, row in latest.items():
+        name, labels = key
+        if name != "serve_requests_total":
+            continue
+        used.add(key)
+        counts[dict(labels).get("event", "?")] = row.get("value", 0.0)
+    if not counts:
+        return []
+    submitted = counts.get("submitted", 0.0)
+    offered = submitted + counts.get("rejected", 0.0)
+    rows = []
+    for ev in list(_OUTCOME_ORDER) + sorted(set(counts) -
+                                            set(_OUTCOME_ORDER)):
+        if ev not in counts:
+            continue
+        if ev == "submitted":
+            pct = (f"{100.0 * submitted / offered:.1f}% of offered"
+                   if offered else "-")
+        elif ev == "rejected":
+            pct = (f"{100.0 * counts[ev] / offered:.1f}% of offered"
+                   if offered else "-")
+        else:
+            pct = (f"{100.0 * counts[ev] / submitted:.1f}% of submitted"
+                   if submitted else "-")
+        rows.append([ev, f"{counts[ev]:g}", pct])
+    return _table("Request outcomes", ["event", "count", "share"],
+                  rows)
+
+
+def _overload_timeline(rows: List[dict], used) -> List[str]:
+    """Overload-state timeline from EVERY serve_overload sample in the
+    (append-only) dump, in file order — each registry dump contributes
+    one point, so repeated dumps trace the shedding episodes."""
+    samples = [r for r in rows if r.get("name") == "serve_overload"]
+    if not samples:
+        return []
+    used.add(("serve_overload", tuple()))
+    t0 = next((r["ts"] for r in samples
+               if isinstance(r.get("ts"), (int, float))), None)
+    out, last = [], None
+    for r in samples:
+        state = "OVERLOADED (shedding)" if r.get("value") else "normal"
+        if state == last:
+            continue
+        last = state
+        ts = r.get("ts")
+        rel = (f"+{ts - t0:.2f}s"
+               if isinstance(ts, (int, float)) and t0 is not None
+               else "-")
+        out.append([rel, state])
+    return _table("Overload state timeline", ["t", "state"], out)
+
+
+def _serve_section(latest, used, raw_rows: Optional[List[dict]] = None) \
+        -> List[str]:
+    """--serve: per-request latency histograms, request outcomes, the
+    overload timeline + queue/occupancy gauges from the serving engine's
+    registry stream (docs/SERVING.md)."""
     lat_rows = []
     for name in ("serve_ttft_seconds", "serve_tpot_seconds",
                  "serve_e2e_seconds", "serve_decode_step_seconds",
@@ -156,6 +225,8 @@ def _serve_section(latest, used) -> List[str]:
     out = _table("Serving latency (per-request histograms)",
                  ["series", "labels", "count", "mean ms", "~p50 ms",
                   "~p99 ms"], lat_rows)
+    out += _serve_outcomes(latest, used)
+    out += _overload_timeline(raw_rows or [], used)
     occ_rows, g_rows, c_rows, prog_rows = [], [], [], []
     for key in sorted(latest):
         name, labels = key
@@ -196,7 +267,10 @@ def _serve_section(latest, used) -> List[str]:
 # report renders dumps without importing the framework)
 _RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
                     "collective_timeout", "nonfinite_skip", "preempted",
-                    "trip", "chaos")
+                    "trip", "chaos", "request_failed", "request_expired",
+                    "request_cancelled", "request_drained",
+                    "request_shed", "decode_watchdog", "overload",
+                    "drained")
 
 
 def _recovery_section(events: List[dict]) -> List[str]:
@@ -271,7 +345,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
 
     # -- serving (--serve) first: its histograms would otherwise be
     # swallowed by the generic slowest-events table ----------------------
-    serve_out: List[str] = _serve_section(latest, used) if serve else []
+    serve_out: List[str] = (_serve_section(latest, used, raw_rows=rows)
+                            if serve else [])
 
     # -- slowest timing histograms ----------------------------------------
     timings = []
