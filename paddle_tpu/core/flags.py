@@ -260,6 +260,12 @@ define_flag("pallas_interpret", False,
             "marker flips it); production CPU dispatch keeps the XLA "
             "fallbacks. flash_attention keeps its own shape gate in "
             "ops.attention and ignores this flag.")
+define_flag("pipeline_schedule", "",
+            "Global pipeline-schedule override for SPMD pipeline stacks: "
+            "'1f1b' (one-forward-one-backward combined program) or "
+            "'fill_drain' (GPipe fwd scan + autodiff mirror — the "
+            "kill-switch-compatible fallback). Empty = resolve from the "
+            "model/fleet strategy (pipeline_configs['schedule_mode']).")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

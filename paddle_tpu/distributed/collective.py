@@ -660,9 +660,12 @@ def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None
         return jax.lax.all_to_all(s, ax, split_axis=1, concat_axis=0,
                                   tiled=False).swapaxes(0, 1)
 
-    with _comm_trace("alltoall", g, x, ("alltoall",)):
+    # traced under the canonical lax op name (comm::all_to_all RecordEvent
+    # + comm_* registry series) — the MoE dispatch primitive's telemetry,
+    # ROADMAP item 5's prerequisite for expert-parallel overlap work
+    with _comm_trace("all_to_all", g, x, ("all_to_all",)):
         out = _run_collective(
-            "alltoall", g, _eager_shardmap(g, ("alltoall",), body), x)
+            "all_to_all", g, _eager_shardmap(g, ("all_to_all",), body), x)
     return _rewrap(out, in_tensor_list)
 
 

@@ -19,12 +19,13 @@ from .. import env
 from .dataset import InMemoryDataset, QueueDataset
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
-                       get_hybrid_communicate_group,
-                       set_hybrid_communicate_group)
+                       MeshTopologyError, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group, validate_topology)
 
 __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
-           "InMemoryDataset", "QueueDataset",
+           "InMemoryDataset", "QueueDataset", "MeshTopologyError",
            "CommunicateTopology", "get_hybrid_communicate_group",
+           "validate_topology",
            "distributed_model", "distributed_optimizer", "reset",
            "worker_index", "worker_num", "is_first_worker",
            "barrier_worker", "init_is_called",

@@ -27,10 +27,52 @@ from ..collective import Group, new_group
 from ..spmd import make_mesh
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup",
-           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+           "MeshTopologyError", "validate_topology"]
 
 # mesh axis order: outermost → innermost
 _AXIS_ORDER = ("dp", "pp", "sharding", "sp", "mp")
+
+
+class MeshTopologyError(ValueError):
+    """The requested hybrid-parallel degrees do not factor the visible
+    device count. Raised by name at ``fleet.init`` /
+    ``HybridCommunicateGroup`` instead of the shape error a mismatched
+    mesh used to hit deep inside ``make_mesh``."""
+
+
+def validate_topology(degrees: Dict[str, int], n_devices: int) -> int:
+    """Validate that the axis degrees exactly factor ``n_devices``.
+
+    The product must be positive and DIVIDE the visible device count (a
+    sub-mesh over a device prefix is legal — tests pin pp-only meshes on
+    8-device hosts); a product that exceeds the device count, divides
+    nothing, or contains a non-positive degree raises
+    :class:`MeshTopologyError` naming the offending configuration.
+    Returns the product."""
+    bad = {k: v for k, v in degrees.items() if int(v) < 1}
+    if bad:
+        raise MeshTopologyError(
+            f"hybrid-parallel degrees must be >= 1, got {bad} "
+            f"(full config: {dict(degrees)})")
+    n = int(np.prod([int(v) for v in degrees.values()])) if degrees else 1
+    desc = "x".join(f"{k}{int(v)}" for k, v in degrees.items())
+    if n > n_devices:
+        raise MeshTopologyError(
+            f"mesh {desc} needs {n} devices, but only {n_devices} are "
+            "visible. Lower a degree, or expose more devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=N for "
+            "virtual CPU meshes).")
+    if n_devices % n:
+        raise MeshTopologyError(
+            f"mesh {desc} ({n} ranks) does not factor the {n_devices} "
+            f"visible devices ({n_devices} % {n} = {n_devices % n}): "
+            "every device must belong to exactly one rank position or "
+            "sit in an unused tail that the used prefix tiles evenly. "
+            "Pick degrees whose product divides the device count, or "
+            "pass an explicit devices= prefix of the right length to "
+            "HybridCommunicateGroup.")
+    return n
 
 
 class CommunicateTopology:
@@ -120,11 +162,9 @@ class HybridCommunicateGroup:
         self.nranks = self._topo.world_size()
 
         devices = list(devices if devices is not None else jax.devices())
-        if self.nranks > len(devices):
-            raise ValueError(
-                f"hybrid topology dp{dp_degree}×pp{pp_degree}×"
-                f"sharding{sharding_degree}×sp{sp_degree}×mp{mp_degree} "
-                f"needs {self.nranks} devices, have {len(devices)}")
+        validate_topology(
+            {"dp": dp_degree, "pp": pp_degree, "sharding": sharding_degree,
+             "sp": sp_degree, "mp": mp_degree}, len(devices))
         self.mesh: Mesh = make_mesh(
             {"dp": dp_degree, "pp": pp_degree, "sharding": sharding_degree,
              "sp": sp_degree, "mp": mp_degree}, devices=devices)

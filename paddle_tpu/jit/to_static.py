@@ -408,6 +408,33 @@ class TrainStep:
         from ..utils.compilation import compile_counts
         self._cc0 = compile_counts()
         self._ec0 = eager_cache_stats()
+        # pipeline-aware dispatch guard: when the model carries an SPMD
+        # pipeline over a pp>1 mesh, the whole step program IS the
+        # pipeline dispatch path — run it under the PR 5 collective
+        # watchdog (FLAGS_collective_timeout_s + chaos collective.hang)
+        # so a hung stage handoff raises CollectiveTimeoutError on the
+        # controller instead of stalling training (docs/PARALLELISM.md).
+        self._pp_degree = 0
+        try:
+            from ..distributed.meta_parallel.spmd_pipeline import (
+                PipelineStageStack)
+            for sub in layer.sublayers(include_self=True):
+                if isinstance(sub, PipelineStageStack):
+                    self._pp_degree = max(self._pp_degree,
+                                          sub._pp_degree())
+        except Exception:
+            pass
+
+    def _dispatch(self, jitted, *args):
+        """Invoke a compiled step program; pipeline-carrying steps run
+        under the collective watchdog (zero overhead with the timeout
+        flag unset and no chaos armed)."""
+        if self._pp_degree > 1:
+            from ..distributed import collective as _coll
+            from ..distributed.meta_parallel.spmd_pipeline import _pp_group
+            return _coll._run_collective(
+                "pipeline_step", _pp_group(self._pp_degree), jitted, *args)
+        return jitted(*args)
 
     # -- SPMD layout -------------------------------------------------------
     def _param_specs(self):
@@ -847,8 +874,9 @@ class TrainStep:
             t0 = time.perf_counter() if mon else 0.0
             with _control_flow_guidance(), self._step_span(
                     mon, "TrainStep.accum_microstep"):
-                self.buffers, self._acc_grads, loss = jitted(
-                    self.params, self.buffers, self._acc_grads, key, flat)
+                self.buffers, self._acc_grads, loss = self._dispatch(
+                    jitted, self.params, self.buffers, self._acc_grads,
+                    key, flat)
             dispatch_s = time.perf_counter() - t0 if mon else None
             if _chaos.active() and _chaos.probe("grad.nonfinite"):
                 loss = jnp.full_like(loss, jnp.nan)
@@ -887,8 +915,9 @@ class TrainStep:
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(
                 mon, "TrainStep.grad_accum_sync"):
-            out = jitted(self.params, self.buffers, self.opt_state,
-                         self._acc_grads, lr, t, key, flat)
+            out = self._dispatch(jitted, self.params, self.buffers,
+                                 self.opt_state, self._acc_grads, lr, t,
+                                 key, flat)
         # the k-th microstep is the accumulation SYNC boundary: grads are
         # folded into the optimizer here (reference: the gated update
         # block of gradient_merge_optimizer.py)
@@ -962,8 +991,8 @@ class TrainStep:
                 else None)
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(mon):
-            out = jitted(self.params, self.buffers, self.opt_state, lr, t,
-                         key, flat)
+            out = self._dispatch(jitted, self.params, self.buffers,
+                                 self.opt_state, lr, t, key, flat)
         dispatch_s = time.perf_counter() - t0 if mon else None
         if mon:
             self._record_step_metrics(t_wall, dispatch_s)

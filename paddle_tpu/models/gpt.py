@@ -592,7 +592,8 @@ class GPTForPretrainingPipe(Layer):
     """
 
     def __init__(self, cfg: GPTConfig,
-                 num_microbatches: Optional[int] = None):
+                 num_microbatches: Optional[int] = None,
+                 schedule: Optional[str] = None):
         super().__init__()
         from ..distributed.meta_parallel.spmd_pipeline import (
             PipelineStageStack)
@@ -610,10 +611,10 @@ class GPTForPretrainingPipe(Layer):
         self.embedding_dropout = Dropout(cfg.hidden_dropout_prob)
         self.blocks = PipelineStageStack(
             lambda: GPTDecoderLayer(cfg), cfg.num_layers,
-            num_microbatches=num_microbatches)
+            num_microbatches=num_microbatches, schedule=schedule)
         self.final_norm = LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids, position_ids=None):
+    def _embed(self, input_ids, position_ids=None):
         S = input_ids.shape[1]
         if position_ids is None:
             from ..tensor.creation import arange
@@ -624,9 +625,60 @@ class GPTForPretrainingPipe(Layer):
         sp = _seq_spec(self.cfg)
         if sp:
             x = _constrain(x, BATCH, sp, None)
-        x = self.blocks(x)
+        return x
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.blocks(self._embed(input_ids, position_ids))
         x = self.final_norm(x)
         return parallel_logits(x, self.word_embeddings.weight)
+
+    def _head_apply(self):
+        """The pipeline loss head as a raw-array function over explicit
+        leaves — final LayerNorm -> tied vocab-parallel logits -> masked
+        CE (loss_sum, mask_sum). The SAME math as
+        forward()+GPTPretrainingCriterion, packaged so the 1F1B schedule
+        can run it per microbatch on the last stage (and the fill-drain
+        path on the full batch) — schedule parity by construction."""
+        cached = self.__dict__.get("_head_apply_fn")
+        if cached is not None:
+            return cached
+        from ..core.tensor import Tensor
+        from ..jit.functional import bind
+        norm = self.final_norm
+        norm_names = [n for n, _ in norm.named_parameters()]
+        ce = ParallelCrossEntropy()
+
+        def head_apply(leaves, y, lab, msk):
+            with bind(norm, dict(zip(norm_names, leaves))):
+                h = norm(Tensor(y))
+            logits = parallel_logits(h, Tensor(leaves[len(norm_names)]))
+            losses = ce(logits, Tensor(lab))
+            ls = losses._data if isinstance(losses, Tensor) else losses
+            ls = jnp.squeeze(ls, -1).astype(jnp.float32)
+            m = msk.astype(jnp.float32)
+            return jnp.sum(ls * m), jnp.sum(m)
+
+        self.__dict__["_head_apply_fn"] = head_apply
+        return head_apply
+
+    def pretraining_loss(self, input_ids, labels, loss_mask=None,
+                         position_ids=None):
+        """Schedule-aware pretraining loss: embeddings ->
+        ``PipelineStageStack.train_loss`` (1F1B combined program on
+        capable pp meshes, fill-drain otherwise) -> masked-mean CE.
+        Numerically equivalent to
+        ``GPTPretrainingCriterion()(self(ids), labels, loss_mask)`` up to
+        the per-microbatch summation order (pinned at 1e-6)."""
+        from ..core.tensor import Tensor
+        x = self._embed(input_ids, position_ids)
+        if loss_mask is None:
+            ones = jnp.ones(tuple(labels.shape), jnp.float32)
+            loss_mask = Tensor(ones)
+        head_leaves = [p for _, p in self.final_norm.named_parameters()]
+        head_leaves.append(self.word_embeddings.weight)
+        return self.blocks.train_loss(
+            x, self._head_apply(), head_leaves, [labels, loss_mask],
+            head_token=("gpt_pipe_head", id(self)))
 
 
 class _GPTEmbeddingStage(Layer):

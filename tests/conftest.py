@@ -47,6 +47,13 @@ def pytest_configure(config):
         "every test by the autouse _serving_isolation fixture)")
     config.addinivalue_line(
         "markers",
+        "multichip: exercises DP×TP×PP programs over the 8-device "
+        "virtual CPU mesh this conftest forces via "
+        "--xla_force_host_platform_device_count (pipeline schedule "
+        "stats are reset around every test by the autouse "
+        "_pipeline_isolation fixture)")
+    config.addinivalue_line(
+        "markers",
         "pallas: runs ops.pallas kernel BODIES on the CPU test backend "
         "via the Pallas interpreter (the autouse _pallas_interpret "
         "fixture forces FLAGS_pallas_interpret for marked tests, so "
@@ -69,6 +76,23 @@ def _pallas_interpret(request):
             yield
     else:
         yield
+
+
+@pytest.fixture(autouse=True)
+def _pipeline_isolation():
+    """Pipeline-schedule telemetry (PIPELINE_STATS, the fallback
+    warn-once set) must not leak between tests, so multichip tests can
+    pin exact program-build/fallback counts."""
+    import sys
+    mod = sys.modules.get(
+        "paddle_tpu.distributed.meta_parallel.spmd_pipeline")
+    if mod is not None:
+        mod.reset_pipeline_stats()
+    yield
+    mod = sys.modules.get(
+        "paddle_tpu.distributed.meta_parallel.spmd_pipeline")
+    if mod is not None:
+        mod.reset_pipeline_stats()
 
 
 @pytest.fixture(autouse=True)

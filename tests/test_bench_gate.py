@@ -118,3 +118,29 @@ def test_compare_still_flags_disappearance_for_cli_gate():
     new = [_m("a", 1.0, "tokens/s")]
     assert any("disappeared" in p for p in check_bench.compare(old, new))
     assert check_bench.compare_common(old, new) == []
+
+
+def test_weak_scaling_unit_gates_on_absolute_points():
+    """weak% (weak-scaling efficiency, MULTICHIP record) is
+    higher-is-better and gates on ABSOLUTE points: near-100 baselines
+    must trip on a 9-point loss the relative band would hide."""
+    old = [_m("multichip_weak_scaling_eff_pp2", 96.0, "weak%")]
+    ok = [_m("multichip_weak_scaling_eff_pp2", 88.0, "weak%")]   # -8 pts
+    bad = [_m("multichip_weak_scaling_eff_pp2", 85.0, "weak%")]  # -11 pts
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "-11.0 points" in problems[0]
+    # direction: efficiency IMPROVING never trips
+    up = [_m("multichip_weak_scaling_eff_pp2", 99.9, "weak%")]
+    assert check_bench.compare(old, up, tolerance=0.10) == []
+
+
+def test_bubble_unit_gates_on_absolute_points_growth():
+    """bubble% (pipeline idle share) regresses when it GROWS, on
+    absolute points — a 0-baseline (pp=1) stays gateable."""
+    old = [_m("multichip_1f1b_bubble_pct", 0.0, "bubble%")]
+    ok = [_m("multichip_1f1b_bubble_pct", 9.0, "bubble%")]
+    bad = [_m("multichip_1f1b_bubble_pct", 20.0, "bubble%")]
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "+20.0 points" in problems[0]

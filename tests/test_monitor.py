@@ -238,10 +238,36 @@ def test_eager_broadcast_and_alltoall_traced():
     g = C.new_group([0, 1])
     with scoped_registry() as reg:
         C.broadcast(jnp.ones((2, 3), jnp.float32), src=0, group=g)
-        C.alltoall(jnp.ones((2, 2, 3), jnp.float32), group=g)
+        x = jnp.ones((2, 2, 3), jnp.float32)
+        C.alltoall(x, group=g)
         ops = {lab["op"] for lab, _ in
                reg.counter("comm_ops_total").samples()}
-        assert {"broadcast", "alltoall"} <= ops
+        # canonical lax op name — the MoE dispatch primitive's telemetry
+        assert {"broadcast", "all_to_all"} <= ops
+        labels = {"op": "all_to_all", "group": g.axis_name,
+                  "nranks": g.nranks}
+        assert reg.counter("comm_bytes_total").value(**labels) == x.nbytes
+        # first dispatch pays trace+compile -> cold histogram
+        assert reg.histogram("comm_cold_dispatch_seconds").count(
+            **labels) == 1
+        C.alltoall(x, group=g)
+        assert reg.histogram("comm_latency_seconds").count(**labels) == 1
+
+
+def test_alltoall_comm_record_event_span():
+    """The all_to_all dispatch emits a comm::all_to_all RecordEvent so
+    the collective shows on host timelines when a profiler is open."""
+    import jax.numpy as jnp
+    from paddle_tpu import profiler as prof
+    from paddle_tpu.distributed import collective as C
+    g = C.new_group([0, 1])
+    prof.start_profiler(log_dir=None)
+    try:
+        C.alltoall(jnp.ones((2, 2, 3), jnp.float32), group=g)
+        names = set(prof._events)
+    finally:
+        prof.stop_profiler()
+    assert "comm::all_to_all" in names, sorted(names)
 
 
 def test_traced_collectives_do_not_record():

@@ -35,8 +35,13 @@ _TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
 # percentage points: the healthy baseline is 0, where a relative ratio
 # is undefined and the v_old==0 skip would otherwise make the metric
 # ungateable ("%" alone stays rate-like and relative:
-# serve_availability_pct regresses when it shrinks)
-_ABS_POINT_UNITS = {"shed%"}
+# serve_availability_pct regresses when it shrinks). bubble% is the
+# pipeline-schedule idle share (MULTICHIP record) — same shape.
+_ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%"}
+# bounded 0-100 QUALITY rates (a drop is the regression), also gated on
+# absolute points: weak-scaling efficiency sits near 100, where the
+# relative 10% band would hide a 9-point efficiency loss
+_ABS_POINT_HIGHER_UNITS = {"weak%"}
 
 
 def _metric_list(record) -> List[dict]:
@@ -93,6 +98,14 @@ def compare(old: List[dict], new: List[dict],
                 problems.append(
                     f"{name}: {v_old:g} -> {v_new:g} {unit} "
                     f"(+{delta:.1f} points, tolerance "
+                    f"{tolerance * 100:.0f} points)")
+            continue
+        if unit.strip().lower() in _ABS_POINT_HIGHER_UNITS:
+            delta = v_old - v_new             # a drop is the regression
+            if delta > tolerance * 100.0:
+                problems.append(
+                    f"{name}: {v_old:g} -> {v_new:g} {unit} "
+                    f"(-{delta:.1f} points, tolerance "
                     f"{tolerance * 100:.0f} points)")
             continue
         if v_old == 0:

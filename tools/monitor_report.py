@@ -13,6 +13,13 @@ stream — prints:
 - with ``--memory``: per-program HBM budget table
   (``train_step_program_*`` gauges) + the live-buffer census
   (``live_buffer_bytes`` by category, from monitor.memory);
+- with ``--comms``: the latency-hiding view — overlapped-vs-exposed comm
+  time per op from the ``comm_overlap_ms`` gauges ``bench.py
+  --multichip`` publishes (phase = serial | exposed | overlapped; eager
+  collectives are synchronous dispatches, so their table is all-exposed
+  by construction) plus the pipeline schedule's comm-model gauges
+  (``pipeline_comm_ops_per_step`` / ``pipeline_bubble_fraction``,
+  docs/PARALLELISM.md);
 - with ``--serve``: the serving engine's per-request latency histograms
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
   occupancy, queue-depth/slot/page gauges and serving program HBM
@@ -35,7 +42,7 @@ preemptions, chaos fires — docs/FAULT_TOLERANCE.md), the event log and
 the last-N step records.
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --kernels
 
@@ -84,6 +91,54 @@ def _table(title: str, headers: List[str],
         lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
     lines.append("")
     return lines
+
+
+def _comms_section(latest, used) -> List[str]:
+    """--comms: overlapped-vs-exposed comm time per op. Traced pipeline
+    collectives never hit the eager dispatch tracer, so their latency
+    hiding is measured by ``bench.py --multichip`` (serial = the op's
+    back-to-back eager time for the schedule's per-step traffic, exposed
+    = the step-time residual the mesh run actually pays, overlapped =
+    serial − exposed) and published as ``comm_overlap_ms`` gauges."""
+    out: List[str] = []
+    per: Dict[tuple, dict] = {}
+    for key, row in latest.items():
+        name, labels = key
+        if name != "comm_overlap_ms":
+            continue
+        used.add(key)
+        d = dict(labels)
+        phase = str(d.pop("phase", "?"))
+        per.setdefault(tuple(sorted(d.items())), {})[phase] = \
+            float(row.get("value", 0.0))
+    o_rows = []
+    for labels, d in sorted(per.items()):
+        serial = d.get("serial", 0.0)
+        exposed = d.get("exposed", 0.0)
+        overl = d.get("overlapped", max(0.0, serial - exposed))
+        share = 100.0 * overl / serial if serial > 0 else 0.0
+        o_rows.append([_fmt_labels(labels), f"{serial:,.2f}",
+                       f"{exposed:,.2f}", f"{overl:,.2f}",
+                       f"{share:.0f}%"])
+    out += _table("Comm/compute overlap per op (bench.py --multichip)",
+                  ["op/mesh/schedule", "serial ms", "exposed ms",
+                   "overlapped ms", "hidden"], o_rows)
+    m_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if name in ("pipeline_comm_ops_per_step",
+                    "pipeline_bubble_fraction",
+                    "pipeline_fallback_total"):
+            used.add(key)
+            m_rows.append([name, _fmt_labels(labels),
+                           f"{latest[key].get('value', 0):g}"])
+    out += _table("Pipeline schedule comm model",
+                  ["metric", "labels", "value"], m_rows)
+    if not o_rows and not m_rows:
+        out.append("(no comm-overlap or pipeline gauges in this dump — "
+                   "run bench.py --multichip with FLAGS_monitor on)")
+        out.append("")
+    return out
 
 
 def _memory_section(latest, used) -> List[str]:
@@ -339,7 +394,7 @@ def render_flight(doc: dict, last: int = 10) -> str:
 
 
 def render(rows: List[dict], top: int = 10, memory: bool = False,
-           serve: bool = False) -> str:
+           serve: bool = False, comms: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -347,6 +402,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     # swallowed by the generic slowest-events table ----------------------
     serve_out: List[str] = (_serve_section(latest, used, raw_rows=rows)
                             if serve else [])
+    # -- comm overlap (--comms) also claims its gauges early -------------
+    comms_out: List[str] = (_comms_section(latest, used) if comms else [])
 
     # -- slowest timing histograms ----------------------------------------
     timings = []
@@ -361,7 +418,7 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     t_rows = [[name, _fmt_labels(labels), str(int(r["count"])),
                f"{s:,.3f}", f"{s / r['count'] * 1e3:,.3f}"]
               for s, name, labels, r in timings[:top]]
-    out = serve_out + _table(
+    out = serve_out + comms_out + _table(
         f"Slowest events (top {top} by total time)",
         ["event", "labels", "count", "total s", "mean ms"], t_rows)
     if len(timings) > top:
@@ -476,6 +533,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve = "--serve" in argv
     if serve:
         argv.remove("--serve")
+    comms = "--comms" in argv
+    if comms:
+        argv.remove("--comms")
     kernels = "--kernels" in argv
     if kernels:
         argv.remove("--kernels")
@@ -502,7 +562,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
-    print(render(rows, top=top, memory=memory, serve=serve), end="")
+    print(render(rows, top=top, memory=memory, serve=serve, comms=comms),
+          end="")
     return 0
 
 
