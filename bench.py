@@ -773,6 +773,186 @@ def bench_kernels(quick: bool = False) -> list:
     return lines
 
 
+def bench_moe_dispatch(T: int, D: int, E: int = 8, top_k: int = 2,
+                       cf: float = 2.0, tag: str = "",
+                       iters: int = 10) -> tuple:
+    """MoE dispatch+combine microbench at [T, D], E experts: wall time
+    AND compiler-attributed bytes_accessed for BOTH implementations —
+    the acceptance evidence that the sort path lowers the dispatch's
+    memory traffic vs the einsum oracle (ISSUE 10). Returns
+    (metric_lines, sort_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.cost_model import normalize_cost_analysis
+    from paddle_tpu.incubate.moe import (einsum_combine, einsum_dispatch,
+                                         moe_capacity, sort_combine,
+                                         sort_dispatch, topk_routing)
+
+    C = moe_capacity(T, cf, E)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    r = topk_routing(logits, top_k, C)
+
+    def run(mode):
+        if mode == "sort":
+            fn = lambda a, rr: sort_combine(          # noqa: E731
+                sort_dispatch(a, rr, E, C), rr, C)
+        else:
+            fn = lambda a, rr: einsum_combine(        # noqa: E731
+                einsum_dispatch(a, rr, E, C), rr, C)
+        jitted = jax.jit(fn)
+        lowered = jitted.lower(x, r)
+        cost = normalize_cost_analysis(lowered.compile().cost_analysis())
+        by = float(cost.get("bytes accessed") or 0.0)
+        jitted(x, r).block_until_ready()
+        ms = steady_ms(lambda: jitted(x, r).ravel()[0], iters=iters)
+        return ms, by
+
+    ms_s, by_s = run("sort")
+    ms_e, by_e = run("einsum")
+    name = tag or f"{T}x{D}"
+    log(f"moe dispatch[{name}]: E={E} k={top_k} C={C} — sort "
+        f"{ms_s:.2f} ms / {by_s / 2**20:.1f} MiB accessed vs einsum "
+        f"{ms_e:.2f} ms / {by_e / 2**20:.1f} MiB "
+        f"({by_e / max(by_s, 1.0):.1f}x less traffic)")
+    if by_s and by_e and by_s >= by_e:
+        log(f"MOE GATE: sort dispatch bytes_accessed ({by_s:.3e}) did "
+            f"NOT improve on einsum ({by_e:.3e}) at E={E} [{name}]")
+    lines = [
+        metric_line(f"moe_dispatch_sort_ms_{name}", ms_s, "ms",
+                    vs_baseline=ms_e / max(ms_s, 1e-9)),
+        metric_line(f"moe_dispatch_einsum_ms_{name}", ms_e, "ms",
+                    vs_baseline=1.0),
+        metric_line(f"moe_dispatch_sort_bytes_{name}", by_s, "bytes",
+                    vs_baseline=by_e / max(by_s, 1.0)),
+        metric_line(f"moe_dispatch_einsum_bytes_{name}", by_e, "bytes",
+                    vs_baseline=1.0),
+    ]
+    return lines, ms_s
+
+
+def _bench_moe_gpt(name: str, cfg, B: int, S: int, warm: int, iters: int,
+                   repeats: int = 2) -> list:
+    """Train-throughput + routing-health record for one MoE GPT config:
+    tokens/s/chip from the jitted TrainStep, drop%/balance harvested
+    from ONE eager forward's router stats (traced steps cannot publish),
+    plus the dispatch microbench at this config's token shape."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       GPTPretrainingCriterion)
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.train()
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, ids, labels):
+        with paddle.amp.auto_cast(level="O1"):
+            return crit(layer(ids), labels) + layer.moe_loss()
+
+    step = TrainStep(model, loss_fn,
+                     AdamW(learning_rate=1e-4,
+                           parameters=model.parameters(),
+                           weight_decay=0.01))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    t0 = time.perf_counter()
+    l0 = float(step(ids, labels))
+    compile_s = time.perf_counter() - t0
+    log(f"moe[{name}]: compile+step1 {compile_s:.1f}s loss={l0:.3f} "
+        f"(E={cfg.moe_experts}, every={cfg.moe_every}, "
+        f"{len(cfg.moe_layer_indices())} MoE layers)")
+    for _ in range(warm):
+        step(ids, labels)
+    float(step(ids, labels))
+    dt = steady_ms(lambda: step(ids, labels), iters=iters,
+                   repeats=repeats) / 1e3
+    tok = B * S / dt
+
+    # routing health from one eager forward (same weights, no jit): the
+    # scan side outputs are concrete there, so the per-layer router
+    # gauges land in the registry for monitor_report --moe
+    from paddle_tpu.core.tensor import no_grad
+    model.eval()
+    with no_grad():
+        model(paddle.to_tensor(ids))
+    n_pub = model.gpt.publish_moe_telemetry()
+    stats = model.gpt.moe_layer_stats()
+    arr = np.asarray(stats._data)          # [L_moe, 5+E]
+    drop_pct = 100.0 * float(arr[:, 2].mean())
+    balance = 100.0 * float(arr[:, 4].mean())
+    entropy = float(arr[:, 3].mean())
+    log(f"moe[{name}]: {dt * 1e3:.1f} ms/step {tok:,.0f} tok/s — "
+        f"drop {drop_pct:.1f}%, balance {balance:.1f}, entropy "
+        f"{entropy:.2f} nats over {n_pub} layers")
+    dlines, _ = bench_moe_dispatch(
+        B * S, cfg.hidden_size, E=cfg.moe_experts, top_k=cfg.moe_top_k,
+        cf=cfg.moe_capacity_factor, tag=name,
+        iters=max(2, iters))
+    return [
+        metric_line(f"moe_{name}_tokens_per_sec_per_chip", tok,
+                    "tokens/s", vs_baseline=1.0),
+        metric_line(f"moe_{name}_drop_pct", drop_pct, "drop%",
+                    vs_baseline=1.0),
+        metric_line(f"moe_{name}_balance", balance, "balance",
+                    vs_baseline=balance / 100.0, entropy=entropy),
+    ] + dlines
+
+
+def bench_moe(quick: bool = False) -> list:
+    """``--moe``: the MoE record (BENCH_moe.json) — sort-vs-einsum
+    dispatch microbench (ms + cost-model bytes_accessed at E=8), the
+    gpt2-tiny-8E smoke and (full runs) the gpt2-345M-8E record:
+    tokens/s/chip, dispatch ms, drop % (lower-is-better absolute
+    points), balance (higher-is-better absolute points) — all gated by
+    tools/check_bench.py. Routing-health gauges land in the registry
+    dump for ``tools/monitor_report.py --moe``."""
+    from paddle_tpu.models.gpt import gpt2_medium, gpt_tiny
+
+    lines = []
+    tiny = gpt_tiny(num_layers=4, moe_experts=8)
+    lines += _bench_moe_gpt("gpt2_tiny_8e", tiny, B=8, S=64,
+                            warm=2, iters=5 if quick else 10)
+    if quick:
+        return lines
+    # gpt2-345M-8E: MoE FFN every 2nd layer (the GShard/Switch
+    # interleave), 8 experts at ffn_size hidden. On the CPU bench
+    # container this is the committed floor record (tiny batch, few
+    # iters); the TPU driver round re-records at full shapes.
+    cfg = gpt2_medium(moe_experts=8, moe_every=2)
+    lines += _bench_moe_gpt("gpt2_345m_8e", cfg, B=2, S=512,
+                            warm=1, iters=2, repeats=1)
+    return lines
+
+
+def run_moe_mode(quick: bool) -> None:
+    """--moe: emit ONLY the MoE metric lines, dump the registry (router
+    gauges for monitor_report --moe) and write/self-gate BENCH_moe.json
+    (full runs) — same contract as --serve/--kernels."""
+    import os
+    metrics = bench_moe(quick=quick)
+    for m in metrics:
+        print(json.dumps(m), flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from paddle_tpu.monitor import get_registry
+        mpath = os.path.join(here, "BENCH_monitor.jsonl")
+        get_registry().dump_jsonl(mpath, extra={"source": "bench_moe"})
+        log(f"monitor: registry dumped to {mpath} "
+            "(render: python tools/monitor_report.py --moe)")
+    except Exception as e:
+        log(f"monitor dump skipped: {e!r}")
+    if quick:
+        log("moe: --quick run, BENCH_moe.json not written")
+        return
+    write_gated_record("BENCH_moe.json", metrics)
+
+
 def bench_multichip(quick: bool = False) -> list:
     """``--multichip``: the DP×TP×PP record on an 8-device VIRTUAL mesh
     (docs/PARALLELISM.md methodology) — weak-scaling efficiency across
@@ -953,11 +1133,141 @@ def bench_multichip(quick: bool = False) -> list:
         except Exception as e:
             log(f"multichip[{name}]: overlap gauges skipped: {e!r}")
 
+    # -- expert-parallel leg (ISSUE 10): MoE GPT over an ep-only mesh,
+    # the only shape whose manual-ep all_to_alls XLA:CPU can compile —
+    # weak-scaling eff + the all_to_all overlap gauges ------------------
+    try:
+        lines += _multichip_moe_ep_leg(B, S, iters, reg)
+    except Exception as e:
+        log(f"multichip[ep8_moe]: leg failed: {e!r}")
+        gates.append(f"ep8_moe: leg failed ({e!r})")
+
     for gname in gates:
         log("MULTICHIP GATE: " + gname)
     if not gates:
         log("multichip gate ok: all shapes ≥ 85% weak-scaling eff, "
             "1F1B bubble within canonical+5pts, loss parity held")
+    return lines
+
+
+def _multichip_moe_ep_leg(B: int, S: int, iters: int, reg) -> list:
+    """The ``ep8_moe`` leg: gpt2-arch tiny with 8 experts in EVERY layer
+    (homogeneous MoE stack, scan-over-layers) trained over an ep-only
+    8-device mesh — the explicit shard_map + all_to_all expert-parallel
+    program. Measures weak-scaling eff vs the SAME model single-device,
+    and publishes ``comm_overlap_ms{op=all_to_all}`` gauges: serial =
+    the model's per-step all_to_all traffic dispatched back-to-back
+    through the EAGER collective (which also lands the measured
+    baseline in the comm_latency series the PR 9 relabel created),
+    exposed = the step-time residual, overlapped = hidden."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import collective as coll, env as dist_env, fleet
+    from paddle_tpu.distributed.spmd import make_mesh
+    from paddle_tpu.incubate.moe import MOE_STATS, reset_moe_stats
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       GPTPretrainingCriterion, gpt_tiny)
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = gpt_tiny(num_layers=4, moe_experts=8)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, i, l):
+        return crit(layer(i), l) + layer.moe_loss()
+
+    def run(mesh):
+        fleet.reset()
+        dist_env.reset()
+        if mesh is not None:
+            dist_env.set_mesh(mesh)
+        paddle.seed(7)
+        model = GPTForPretraining(cfg)
+        kw = dict(mesh=mesh, data_spec=P("ep")) if mesh is not None else {}
+        step = TrainStep(model, loss_fn,
+                         AdamW(learning_rate=1e-3,
+                               parameters=model.parameters()), **kw)
+        args = (Tensor(ids), Tensor(labels))
+        t0 = time.perf_counter()
+        l0 = float(np.asarray(step(*args)._data))
+        compile_s = time.perf_counter() - t0
+        step(*args)
+        ms = steady_ms(lambda: step(*args), iters=iters, repeats=2)
+        return ms, compile_s, l0
+
+    t_single, c_s, l_single = run(None)
+    log(f"multichip[ep8_moe single]: {t_single:.1f} ms/step "
+        f"(compile {c_s:.1f}s, loss={l_single:.4f})")
+    reset_moe_stats()
+    mesh = make_mesh({"ep": 8})
+    t_mesh, c_s, l_mesh = run(mesh)
+    eff = 100.0 * t_single / t_mesh if t_mesh > 0 else 0.0
+    d_loss = abs(l_mesh - l_single)
+    log(f"multichip[ep8_moe]: {t_mesh:.1f} ms/step, weak-scaling eff "
+        f"{eff:.1f}% (compile {c_s:.1f}s, loss Δ={d_loss:.2e} vs "
+        f"single-device — per-shard aux-loss semantics), "
+        f"ep_dispatches={MOE_STATS['ep_dispatches']} "
+        f"fallbacks={MOE_STATS['fallbacks']}")
+    lines = [metric_line("multichip_weak_scaling_eff_ep8_moe", eff,
+                         "weak%", vs_baseline=eff / 85.0)]
+    exposed_pct = max(0.0, 100.0 - eff)
+    lines.append(metric_line("multichip_ep8_moe_exposed_comm_pct",
+                             exposed_pct, "exposed%", vs_baseline=1.0))
+
+    # all_to_all overlap gauges: serial = eager all_to_all dispatches of
+    # the model's per-step exchange traffic (2 directions x chunks x
+    # MoE layers), measured through distributed.alltoall so the
+    # comm_latency_seconds{op=all_to_all} baseline series populates too
+    from paddle_tpu.incubate.moe import moe_capacity, resolve_a2a_chunks
+    n = 8
+    E, D = cfg.moe_experts, cfg.hidden_size
+    C_loc = moe_capacity(B * S // n, cfg.moe_capacity_factor, E)
+    # the ONE chunk-resolution rule _ep_program executes, so the serial
+    # baseline counts the exchanges the model really issues
+    chunks = resolve_a2a_chunks(C_loc)
+    cs = C_loc // chunks
+    # one exchange moves [E, cs, D] per shard = stacked [n, n, ...] blocks
+    rows = max(1, (E // n) * cs)
+    block = jnp.zeros((n, n, rows, D), jnp.float32)
+    g = coll.get_group(0)
+    coll.alltoall(block, group=g)              # build/warm the wrapper
+    one_ms = steady_ms(
+        lambda: coll.alltoall(block, group=g)[0].ravel()[0],
+        iters=iters, repeats=2)
+    # per OPTIMIZER step: 2 forward exchanges per chunk per MoE layer,
+    # and the backward re-issues each one (an all_to_all's transpose is
+    # an all_to_all) — 4 x chunks x layers total
+    a2a_per_step = 4 * chunks * len(cfg.moe_layer_indices())
+    serial_ms = one_ms * a2a_per_step
+    exposed_ms = max(0.0, t_mesh - t_single)
+    overlapped_ms = max(0.0, serial_ms - exposed_ms)
+    if reg is not None:
+        try:
+            gz = reg.gauge(
+                "comm_overlap_ms",
+                "per-op comm time of a pipelined step: serial = "
+                "back-to-back eager dispatch of the schedule's traffic, "
+                "exposed = measured step residual, overlapped = hidden "
+                "by async scheduling (bench.py --multichip)")
+            for phase, v in (("serial", serial_ms),
+                             ("exposed", exposed_ms),
+                             ("overlapped", overlapped_ms)):
+                gz.set(v, op="all_to_all", mesh="ep8_moe", schedule="moe",
+                       phase=phase)
+        except Exception as e:
+            log(f"multichip[ep8_moe]: overlap gauges skipped: {e!r}")
+    log(f"multichip[ep8_moe]: all_to_all serial {serial_ms:.2f} ms "
+        f"({a2a_per_step} exchanges/step @ {one_ms:.3f} ms eager) vs "
+        f"exposed {exposed_ms:.2f} ms ({overlapped_ms:.2f} ms hidden)")
+    fleet.reset()
+    dist_env.reset()
     return lines
 
 
@@ -1114,6 +1424,10 @@ def main() -> None:
         # DP×TP×PP weak-scaling / schedule-quality record
         # (BENCH_multichip) on the 8-device virtual mesh
         run_multichip_mode(quick=not full)
+        return
+    if "--moe" in sys.argv:
+        # MoE dispatch + gpt-8E record (BENCH_moe)
+        run_moe_mode(quick=not full)
         return
     metrics = []
 

@@ -266,6 +266,35 @@ define_flag("pipeline_schedule", "",
             "'fill_drain' (GPipe fwd scan + autodiff mirror — the "
             "kill-switch-compatible fallback). Empty = resolve from the "
             "model/fleet strategy (pipeline_configs['schedule_mode']).")
+define_flag("moe_dispatch", "sort",
+            "MoE token dispatch/combine implementation "
+            "(paddle_tpu.incubate.moe): 'sort' (default) = argsort-by-"
+            "expert + static-shape gather/scatter — O(T·k·D) memory "
+            "traffic, the TPU-efficient path; 'einsum' = the GShard "
+            "one-hot dispatch/combine einsums that materialize "
+            "O(T·E·C) tensors — the parity oracle and kill switch "
+            "(bit-compatible with the pre-sort implementation). Both "
+            "paths share one router, so capacity clipping and drop "
+            "decisions are identical.")
+define_flag("moe_expert_parallel", True,
+            "Run stacked-expert MoE layers through the EXPLICIT "
+            "expert-parallel program (shard_map manual over the 'ep' "
+            "mesh axis + lax.all_to_all token exchange, double-buffered "
+            "in capacity chunks so the all-to-alls overlap expert "
+            "compute) when an ep>1 mesh is active and the backend can "
+            "compile it. Off (or on incapable backends — XLA:CPU with "
+            "another nontrivial mesh axis) = the GSPMD auto path: "
+            "expert weights keep their P('ep', ...) specs and XLA "
+            "inserts the collectives (counted moe_fallback_total "
+            "telemetry, nn.scan fallback convention).")
+define_flag("moe_a2a_chunks", 2,
+            "Capacity-dim chunks of the expert-parallel all_to_all "
+            "double buffer: each chunk's tokens-out all_to_all issues "
+            "before any expert compute and its tokens-back all_to_all "
+            "issues right after that chunk's FFN, so XLA's async "
+            "scheduler can hide chunk i+1's exchange behind chunk i's "
+            "compute (the PR 9 ppermute double-buffer recipe applied "
+            "to ISSUE 10's expert exchange). 1 = no chunking.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -20,7 +20,7 @@ from . import env
 
 __all__ = ["make_mesh", "shard_map", "named_sharding", "current_mesh",
            "PartitionSpec", "apply_param_shardings", "constrain", "BATCH",
-           "data_axes"]
+           "data_axes", "degrade_spec"]
 
 PartitionSpec = P
 
@@ -34,6 +34,27 @@ _DATA_AXES = ("dp", "sharding")
 def data_axes(mesh: Mesh):
     """The mesh axes the batch dim is sharded over (dp + ZeRO sharding)."""
     return tuple(a for a in _DATA_AXES if a in mesh.axis_names)
+
+
+def _degrade_entry(s, names):
+    """One PartitionSpec entry with axis names absent from ``names``
+    degraded to None/dropped (replicated) — the shared rule behind
+    :func:`constrain`, :func:`apply_param_shardings` and TrainStep's
+    ``_param_specs``: a model annotated for mp/ep composes with any
+    sub-mesh that lacks those axes."""
+    if isinstance(s, str):
+        return s if s in names else None
+    if isinstance(s, (tuple, list)):
+        kept = tuple(a for a in s if a in names)
+        return kept if kept else None
+    return s
+
+
+def degrade_spec(spec, mesh: Mesh) -> P:
+    """A full PartitionSpec with absent-axis entries degraded for
+    ``mesh`` (no BATCH sentinel handling — that is constrain-only)."""
+    names = set(mesh.axis_names)
+    return P(*(_degrade_entry(s, names) for s in tuple(spec)))
 
 
 def constrain(x, *spec):
@@ -53,12 +74,7 @@ def constrain(x, *spec):
         if s == BATCH:
             axes = data_axes(mesh)
             return axes if axes else None
-        if isinstance(s, str):
-            return s if s in names else None
-        if isinstance(s, (tuple, list)):
-            kept = tuple(a for a in s if a in names)
-            return kept if kept else None
-        return s
+        return _degrade_entry(s, names)
     clean = tuple(clean_one(s) for s in spec)
     ndim = len(x.shape)
     clean = clean[:ndim] + (None,) * max(0, ndim - len(clean))
@@ -108,7 +124,8 @@ def apply_param_shardings(layer, mesh: Optional[Mesh] = None):
         raise ValueError("no active mesh; call fleet.init or pass mesh=")
     for _, p in layer.named_parameters():
         spec = getattr(p, "spec", None) or P()
-        p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+        p._data = jax.device_put(
+            p._data, NamedSharding(mesh, degrade_spec(spec, mesh)))
     for _, b in layer.named_buffers():
         b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
     return layer

@@ -1,0 +1,652 @@
+"""Mixture-of-Experts layers: gated MoE with expert parallelism.
+
+reference parity: distributed/utils.py global_scatter(:57)/global_gather
+(:151) over the global_scatter/global_gather ops
+(operators/collective/global_scatter_op.cc — all-to-all by per-expert
+counts). The reference ships ONLY those primitives ("ops only, no python
+MoE layer yet", SURVEY §2.3); this subsystem completes the story.
+
+TPU-native design (ISSUE 10):
+
+- ONE router (``routing.py``) feeds TWO dispatch implementations
+  (``dispatch.py``): the GShard one-hot einsums (the parity oracle,
+  ``FLAGS_moe_dispatch=einsum``) and the default argsort-by-expert
+  static-shape gather/scatter path whose data movement is O(T·k·D)
+  instead of O(T·E·C·D).
+- Expert weights are STACKED [E, ...] leaves with P('ep', ...) specs.
+  Without an ep>1 mesh (or where the explicit program cannot compile)
+  XLA's GSPMD partitioner handles placement — the *auto* path. With an
+  ep>1 mesh and a capable backend, :class:`MoELayer` runs the EXPLICIT
+  expert-parallel program: one ``shard_map`` manual over ``ep`` whose
+  body routes its local tokens, exchanges capacity chunks with
+  ``lax.all_to_all`` (both directions issued OUTSIDE the expert-compute
+  chain and double-buffered over ``FLAGS_moe_a2a_chunks`` chunks so the
+  async scheduler hides them behind FFN compute — the PR 9 ppermute
+  recipe), and combines locally. Eager dispatches of that program run
+  under the PR 5 collective watchdog (chaos site ``collective.hang``),
+  so a hung expert exchange raises a structured
+  ``CollectiveTimeoutError`` instead of stalling the controller.
+- Router telemetry is always computed (drop fraction, routing entropy,
+  per-expert load shares, balance) and rides ``Routing.stats``; when the
+  forward runs eagerly (concrete values) and the monitor is enabled, the
+  layer publishes ``moe_router_*`` gauges + the ``moe_dropped_tokens_
+  total`` counter; :func:`publish_router_stats` harvests explicitly
+  (tools/monitor_report.py --moe renders them).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.flags import get_flag, matmul_precision
+from ...core.tensor import Tensor, apply
+from ...nn.layer import Layer, LayerList
+from .dispatch import (einsum_combine, einsum_dispatch,
+                       resolve_dispatch_mode, sort_combine, sort_dispatch)
+from .routing import (Routing, STATS_FIELDS, moe_capacity, topk_routing)
+
+__all__ = ["EP_AXIS", "MOE_STATS", "reset_moe_stats", "note_moe_fallback",
+           "global_scatter", "global_gather", "ExpertFFN", "MoELayer",
+           "expert_ffn_apply", "publish_router_stats",
+           "resolve_a2a_chunks", "moe_ep_group"]
+
+EP_AXIS = "ep"
+
+
+def resolve_a2a_chunks(local_capacity: int, flag_value=None) -> int:
+    """The expert-parallel double-buffer chunk count actually executed:
+    ``FLAGS_moe_a2a_chunks`` reduced until the chunk width tiles the
+    local capacity. ONE resolution rule shared by ``_ep_program`` and
+    the bench's serial all_to_all baseline — the exchange count they
+    model must match the exchanges the program issues."""
+    chunks = max(1, int(get_flag("moe_a2a_chunks")
+                        if flag_value is None else flag_value))
+    while local_capacity % chunks:
+        chunks -= 1
+    return chunks
+
+
+def moe_ep_group(n: int):
+    """The watchdog/telemetry Group naming the ep axis (no ring
+    bootstrap). ONE identity shared by the eager expert-parallel
+    dispatch guard and TrainStep's step-program guard, so timeout
+    attribution for the same expert all_to_all never diverges between
+    the two dispatch paths."""
+    from ...distributed.collective import Group
+    return Group(list(range(n)), gid=-102, axis_name=EP_AXIS)
+
+#: observability (the nn/scan SCAN_STATS convention): explicit
+#: expert-parallel program dispatches, auto-path dispatches by mode, and
+#: fallbacks (ep>1 mesh present but the explicit program could not run).
+MOE_STATS = {"ep_dispatches": 0, "sort_dispatches": 0,
+             "einsum_dispatches": 0, "fallbacks": 0}
+
+_FALLBACK_WARNED: set = set()
+
+
+def reset_moe_stats():
+    MOE_STATS["ep_dispatches"] = 0
+    MOE_STATS["sort_dispatches"] = 0
+    MOE_STATS["einsum_dispatches"] = 0
+    MOE_STATS["fallbacks"] = 0
+    _FALLBACK_WARNED.clear()
+
+
+def note_moe_fallback(reason: str, detail: str = "") -> None:
+    """An ep>1 mesh is active but the explicit expert-parallel program
+    degraded to the GSPMD auto path — same math, no measured all_to_all
+    overlap structure. One-time warning per cause + counted (monitor
+    mode adds a ``moe_fallback_total`` registry counter)."""
+    MOE_STATS["fallbacks"] += 1
+    key = (reason, detail)
+    if key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(key)
+        warnings.warn(
+            f"MoE expert parallelism degraded to the GSPMD auto path "
+            f"(reason: {reason}{'; ' + detail if detail else ''}); the "
+            "math is unchanged but the explicit all_to_all program does "
+            "not run. On XLA:CPU this is expected for meshes with other "
+            "nontrivial axes (manual-subgroup collectives); on TPU check "
+            "FLAGS_moe_expert_parallel and the mesh axes.",
+            RuntimeWarning, stacklevel=3)
+    from ...monitor import enabled as _mon_enabled
+    if _mon_enabled():
+        from ...monitor import get_registry
+        get_registry().counter(
+            "moe_fallback_total",
+            "ep meshes that degraded to the GSPMD auto path, by cause",
+        ).inc(reason=reason)
+
+
+def _check_uniform_counts(counts, what: str, total: Optional[int] = None):
+    """The static-shape all_to_all only implements the uniform-counts case
+    (GShard fixed capacity). Variable per-expert counts — the reference's
+    general global_scatter semantics — would silently mis-route rows here,
+    so reject them loudly instead."""
+    if counts is None:
+        return
+    import numpy as np
+    if isinstance(counts, Tensor):
+        counts = counts._data
+    if isinstance(counts, jax.core.Tracer):
+        # Inside shard_map/jit the counts arrive as tracers whose values
+        # cannot be inspected; uniformity is then the caller's contract
+        # (the tiled all_to_all silently assumes it). Concrete counts —
+        # the eager reference-parity call — are validated below.
+        return
+    arr = np.asarray(counts)
+    if arr.size and not (arr == arr.flat[0]).all():
+        raise NotImplementedError(
+            f"global_scatter/global_gather: non-uniform {what} "
+            f"{arr.tolist()} is unsupported — the TPU lowering is a tiled "
+            "all_to_all which requires equal rows per expert (GShard "
+            "capacity discipline); pad every expert to the same count")
+    if total is not None and arr.size and int(arr.sum()) != int(total):
+        raise ValueError(
+            f"global_scatter/global_gather: {what} sums to {int(arr.sum())} "
+            f"but x has {int(total)} rows — the tiled all_to_all moves "
+            "rows/ep_size rows per rank, so the counts must describe "
+            "exactly the rows present")
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Send rows of ``x`` to experts on other ranks (call inside shard_map
+    over the ep axis; reference: distributed/utils.py:57).
+
+    local_count[i]: rows this rank sends to global expert i;
+    global_count[i]: rows this rank receives for its local experts.
+    Counts must be equal-per-rank (fixed capacity) for the static-shape
+    all-to-all — the GShard capacity discipline; non-uniform counts raise.
+    """
+    from jax import lax
+    rows = x.shape[0]
+    _check_uniform_counts(local_count, "local_count", total=rows)
+    _check_uniform_counts(global_count, "global_count", total=rows)
+    n = lax.psum(1, EP_AXIS)
+    if rows % n:
+        raise ValueError(f"rows {rows} must divide ep size {n}")
+    return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference: distributed/utils.py:151)."""
+    from jax import lax
+    rows = x.shape[0]
+    _check_uniform_counts(local_count, "local_count", total=rows)
+    _check_uniform_counts(global_count, "global_count", total=rows)
+    return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def expert_ffn_apply(x, w1, b1, w2, b2, act=None):
+    """The stacked-expert FFN over raw arrays: [E, C, D] -> [E, C, D].
+    Shared by ExpertFFN.forward and the expert-parallel shard_map body
+    (which feeds it LOCAL slices [E/n, n*C_chunk, D])."""
+    h = jnp.einsum("ecd,edh->ech", x, w1) + b1
+    h = jax.nn.gelu(h) if act is None else act(h)
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+
+class ExpertFFN(Layer):
+    """E homogeneous FFN experts as STACKED parameters [E, ...] with
+    P('ep', ...) specs — the GSPMD expert-parallel formulation: a mesh
+    with an 'ep' axis places one expert group per slice and the expert
+    einsum partitions over it (XLA inserts the all-to-alls on the auto
+    path; MoELayer's explicit program issues them itself)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden))
+        self.w1.spec = P(EP_AXIS, None, None)
+        self.b1 = self.create_parameter((num_experts, 1, d_hidden),
+                                        is_bias=True)
+        self.b1.spec = P(EP_AXIS, None, None)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model))
+        self.w2.spec = P(EP_AXIS, None, None)
+        self.b2 = self.create_parameter((num_experts, 1, d_model),
+                                        is_bias=True)
+        self.b2.spec = P(EP_AXIS, None, None)
+        self.activation = activation
+
+    def forward(self, x):
+        """x: [E, C, D] (per-expert capacity slices) -> [E, C, D]."""
+        act = self.activation
+        # the token encodes the closure-captured activation by identity
+        # (the apply() cache contract): two stacks differing only in
+        # activation must never share a cached trace
+        return apply(
+            lambda a, w1, b1, w2, b2: expert_ffn_apply(a, w1, b1, w2, b2,
+                                                       act),
+            x, self.w1, self.b1, self.w2, self.b2, name="expert_ffn",
+            _cache_token=("expert_ffn", id(self),
+                          id(act) if act is not None else None))
+
+
+class MoELayer(Layer):
+    """Gated mixture of experts (completes the reference's MoE primitives).
+
+    Routing: capacity-disciplined top-``top_k`` gating with an ALWAYS-f32
+    router (the gate runs outside any autocast region on an f32 view of
+    the tokens); ``aux_loss`` (GShard load balance) and ``z_loss``
+    (router logit magnitude) hold the per-call loss terms, ``moe_vec``
+    the combined [aux, z, drop, entropy, balance, load_0..E-1] f32 vector
+    models thread through scan-over-layers.
+
+    Dispatch: ``FLAGS_moe_dispatch`` (or the ``dispatch_mode`` arg)
+    selects sort (default) vs the einsum oracle — see ``dispatch.py``.
+
+    Expert forms:
+    - ``experts=ExpertFFN(...)`` (or num_experts+d_hidden kwargs): stacked
+      parameters with P('ep', ...) specs — REAL expert parallelism; over
+      an ep>1 mesh with a capable backend the layer runs the explicit
+      shard_map + all_to_all program (``FLAGS_moe_expert_parallel``).
+    - ``experts=[Layer, ...]``: arbitrary heterogeneous experts applied in
+      a python loop; parameters are replicated — the flexible
+      single-slice form.
+    """
+
+    def __init__(self, d_model: int, experts=None, gate=None,
+                 capacity_factor: float = 2.0, num_experts: int = None,
+                 d_hidden: int = None, top_k: int = 2,
+                 dispatch_mode: Optional[str] = None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        if experts is None:
+            if not (num_experts and d_hidden):
+                raise ValueError("pass experts= or num_experts+d_hidden")
+            experts = ExpertFFN(num_experts, d_model, d_hidden)
+        if isinstance(experts, ExpertFFN):
+            self.experts = experts
+            self.num_experts = experts.num_experts
+            self._stacked = True
+        else:
+            self.experts = experts if isinstance(experts, LayerList) \
+                else LayerList(list(experts))
+            self.num_experts = len(self.experts)
+            self._stacked = False
+        from ...nn.layers.common import Linear
+        self._default_gate = gate is None
+        self.gate = gate or Linear(d_model, self.num_experts,
+                                   bias_attr=False)
+        self.capacity_factor = capacity_factor
+        self.top_k = int(top_k)
+        if dispatch_mode is not None:
+            resolve_dispatch_mode(dispatch_mode)     # validate eagerly
+        self.dispatch_mode = dispatch_mode
+        self._label = name or "moe"
+        # per-call outputs live under underscore names (properties below):
+        # a public Tensor attribute would enter nn.scan's per-layer config
+        # signature as None before the first forward and vanish after it,
+        # costing the homogeneity check a spurious retrace
+        self._aux_loss: Optional[Tensor] = None
+        self._z_loss: Optional[Tensor] = None
+        self._router_stats: Optional[Tensor] = None
+        self._moe_vec: Optional[Tensor] = None
+        self._last_tokens = 0
+
+    # last-forward outputs (same-trace values: read them in the same
+    # trace/step that produced them)
+    @property
+    def aux_loss(self):
+        """GShard load-balance loss of the last forward."""
+        return self._aux_loss
+
+    @aux_loss.setter
+    def aux_loss(self, v):
+        self.__dict__["_aux_loss"] = v
+
+    @property
+    def z_loss(self):
+        """Router z-loss (squared logsumexp) of the last forward."""
+        return self._z_loss
+
+    @property
+    def router_stats(self):
+        """[drop_frac, entropy, balance_frac, load_0..E-1] f32 vector."""
+        return self._router_stats
+
+    @property
+    def moe_vec(self):
+        """[aux, z, drop, entropy, balance, load_0..E-1] f32 vector — the
+        per-layer side output models thread through scan-over-layers."""
+        return self._moe_vec
+
+    def _capacity(self, tokens: int) -> int:
+        return moe_capacity(tokens, self.capacity_factor, self.num_experts)
+
+    # -- expert-parallel eligibility ---------------------------------------
+    def _ep_degree(self) -> int:
+        from ...distributed import env as dist_env
+        mesh = dist_env.get_mesh()
+        if mesh is not None and EP_AXIS in mesh.axis_names:
+            return int(mesh.shape[EP_AXIS])
+        return 1
+
+    def _ep_eligible(self, n: int, tokens: int) -> bool:
+        """Whether the explicit shard_map + all_to_all program can run
+        (callers only ask when an ep>1 mesh is active); ineligibility is
+        counted as a fallback."""
+        from ...distributed import env as dist_env
+        from ...distributed.meta_parallel.spmd_pipeline import (
+            manual_collectives_ok)
+        if not get_flag("moe_expert_parallel"):
+            note_moe_fallback("flag_off")
+            return False
+        if not self._stacked:
+            note_moe_fallback("heterogeneous_experts")
+            return False
+        if not self._default_gate:
+            note_moe_fallback("custom_gate")
+            return False
+        if self.num_experts % n or tokens % n:
+            note_moe_fallback(
+                "indivisible", f"E={self.num_experts} T={tokens} ep={n}")
+            return False
+        mesh = dist_env.get_mesh()
+        if not manual_collectives_ok(mesh, EP_AXIS):
+            note_moe_fallback(
+                "manual_collectives_unsupported",
+                f"backend={jax.default_backend()} mesh="
+                f"{dict(mesh.shape) if mesh is not None else None}")
+            return False
+        return True
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, x):
+        B, S, D = x.shape
+        tokens = B * S
+        flat = x.reshape((tokens, D))
+
+        # probe the ep degree for hetero stacks too: _ep_eligible is what
+        # records the counted heterogeneous_experts fallback on ep meshes
+        n = self._ep_degree()
+        if n > 1 and self._ep_eligible(n, tokens):
+            out, aux, z, stats = self._expert_parallel_forward(
+                flat, n, tokens, D)
+        else:
+            out, aux, z, stats = self._auto_forward(flat, tokens, D)
+
+        self.__dict__["_aux_loss"] = aux
+        self.__dict__["_z_loss"] = z
+        self.__dict__["_router_stats"] = stats
+        self.__dict__["_last_tokens"] = tokens
+        self.__dict__["_moe_vec"] = apply(
+            lambda a, zz, s: jnp.concatenate(
+                [jnp.stack([a, zz]).astype(jnp.float32), s]),
+            aux, z, stats, name="moe_vec")
+        self._publish_stats()
+        return out.reshape((B, S, D))
+
+    # -- auto (GSPMD) path -------------------------------------------------
+    def _router_logits(self, flat):
+        """f32 router: the gate consumes an f32 view of the tokens with
+        autocast disabled, so bf16 activation streams keep a full-
+        precision router (near-tie argmaxes and the z-loss are
+        ill-conditioned in half precision)."""
+        from ...amp.auto_cast import auto_cast
+        flat32 = apply(lambda a: a.astype(jnp.float32), flat,
+                       name="moe_router_cast")
+        with auto_cast(enable=False):
+            return self.gate(flat32)
+
+    def _auto_forward(self, flat, tokens: int, D: int):
+        C = self._capacity(tokens)
+        E, k = self.num_experts, self.top_k
+        logits = self._router_logits(flat)
+
+        routing = apply(lambda lg: tuple(topk_routing(lg, k, C)), logits,
+                        name="moe_routing", _cache_token=("moe_routing",
+                                                          E, C, k))
+        gates, idx, pos, keep, aux, z, stats = routing
+        for t in (idx, pos, keep, stats):
+            t.stop_gradient = True        # integer-valued / telemetry
+
+        mode = resolve_dispatch_mode(self.dispatch_mode)
+        MOE_STATS[f"{mode}_dispatches"] += 1
+
+        def _r(g, i, p, kp):
+            return Routing(g, i, p, kp, None, None, None)
+
+        if mode == "einsum":
+            expert_in = apply(
+                lambda ff, g, i, p, kp: einsum_dispatch(
+                    ff, _r(g, i, p, kp), E, C),
+                flat, gates, idx, pos, keep, name="moe_dispatch",
+                _cache_token=("moe_dispatch_einsum", E, C, k))
+        else:
+            expert_in = apply(
+                lambda ff, g, i, p, kp: sort_dispatch(
+                    ff, _r(g, i, p, kp), E, C),
+                flat, gates, idx, pos, keep, name="moe_dispatch",
+                _cache_token=("moe_dispatch_sort", E, C, k))
+
+        if self._stacked:
+            expert_out = self.experts(expert_in)          # [E, C, D]
+        else:
+            outs = []
+            for e, expert in enumerate(self.experts):
+                outs.append(expert(expert_in[e]))         # [C, D]
+            from ...tensor.manipulation import stack
+            expert_out = stack(outs, axis=0)              # [E, C, D]
+
+        if mode == "einsum":
+            out = apply(
+                lambda eo, g, i, p, kp: einsum_combine(
+                    eo, _r(g, i, p, kp), C),
+                expert_out, gates, idx, pos, keep, name="moe_combine",
+                _cache_token=("moe_combine_einsum", E, C, k))
+        else:
+            out = apply(
+                lambda eo, g, i, p, kp: sort_combine(
+                    eo, _r(g, i, p, kp), C),
+                expert_out, gates, idx, pos, keep, name="moe_combine",
+                _cache_token=("moe_combine_sort", E, C, k))
+        return out, aux, z, stats
+
+    # -- explicit expert-parallel path -------------------------------------
+    def _expert_parallel_forward(self, flat, n: int, tokens: int, D: int):
+        """shard_map manual over ``ep``: each shard routes its T/n local
+        tokens (LOCAL capacity discipline — the GShard per-device
+        formulation; drop decisions are per shard), exchanges capacity
+        chunks with all_to_all (double-buffered; see module docstring)
+        and combines locally. Kept-token outputs match the auto path
+        exactly; only drop decisions can differ when capacity overflows
+        (local vs global cumsum order)."""
+        from ...distributed import env as dist_env
+        mode = resolve_dispatch_mode(self.dispatch_mode)
+        chunks = resolve_a2a_chunks(self._capacity(tokens // n))
+        mesh_prog = self._ep_program(n, tokens, D,
+                                     str(flat._data.dtype)
+                                     if isinstance(flat, Tensor)
+                                     else str(flat.dtype))
+        MOE_STATS["ep_dispatches"] += 1
+        gate_leaves = [p for _, p in self.gate.named_parameters()]
+        leaves = gate_leaves + [self.experts.w1, self.experts.b1,
+                                self.experts.w2, self.experts.b2]
+
+        def ep_fn(ff, *leaf_arrs):
+            return _guarded_ep_dispatch(n, mesh_prog, ff, *leaf_arrs)
+
+        out, aux, z, stats = apply(
+            ep_fn, flat, *leaves, name="moe_expert_parallel",
+            _cache_token=("moe_ep", id(self), n, tokens, D, mode, chunks,
+                          self.capacity_factor, self.top_k,
+                          id(dist_env.get_mesh())))
+        stats.stop_gradient = True
+        return out, aux, z, stats
+
+    def _ep_program(self, n: int, tokens: int, D: int, dtype: str):
+        """Build (and cache) the jitted shard_map expert-parallel program
+        for (mesh, shapes, dispatch mode, chunking)."""
+        from ...distributed import env as dist_env
+        mesh = dist_env.get_mesh()
+        mode = resolve_dispatch_mode(self.dispatch_mode)
+        T_loc = tokens // n
+        C_loc = self._capacity(T_loc)
+        chunks = resolve_a2a_chunks(C_loc)
+        cache = self.__dict__.setdefault("_ep_cache", {})
+        ckey = (id(mesh), n, tokens, D, dtype, mode, chunks,
+                self.capacity_factor, self.top_k)
+        cached = cache.get(ckey)
+        if cached is not None:
+            return cached
+
+        E, k, act = self.num_experts, self.top_k, self.experts.activation
+        E_loc = E // n
+        cs = C_loc // chunks
+        prec = matmul_precision()
+        n_gate = len([1 for _ in self.gate.named_parameters()])
+
+        def body(x_loc, *leaves):
+            gw = leaves[0]
+            gb = leaves[1] if n_gate > 1 else None
+            w1, b1, w2, b2 = leaves[n_gate:]
+            logits = jnp.matmul(x_loc.astype(jnp.float32),
+                                gw.astype(jnp.float32), precision=prec)
+            if gb is not None:
+                logits = logits + gb.astype(jnp.float32)
+            r = topk_routing(logits, k, C_loc)
+            if mode == "einsum":
+                expert_in = einsum_dispatch(x_loc, r, E, C_loc)
+            else:
+                expert_in = sort_dispatch(x_loc, r, E, C_loc)
+
+            # tokens-out exchanges for EVERY chunk issue before any
+            # expert compute; each chunk's tokens-back exchange issues
+            # right after its FFN — with chunks >= 2 the async scheduler
+            # can hide chunk i+1's exchange behind chunk i's compute
+            sent = []
+            for c in range(chunks):
+                piece = expert_in[:, c * cs:(c + 1) * cs]
+                piece = piece.reshape(n, E_loc, cs, D)
+                sent.append(jax.lax.all_to_all(
+                    piece, EP_AXIS, split_axis=0, concat_axis=0,
+                    tiled=False))                  # [n(src), E_loc, cs, D]
+            back = []
+            for c in range(chunks):
+                rec = sent[c].transpose(1, 0, 2, 3).reshape(
+                    E_loc, n * cs, D)
+                y_c = expert_ffn_apply(rec, w1, b1, w2, b2, act)
+                y_c = y_c.reshape(E_loc, n, cs, D).transpose(1, 0, 2, 3)
+                back.append(jax.lax.all_to_all(
+                    y_c, EP_AXIS, split_axis=0, concat_axis=0,
+                    tiled=False))                  # [n(home), E_loc, cs, D]
+            expert_out = jnp.concatenate(
+                [b.reshape(E, cs, D) for b in back], axis=1)
+
+            if mode == "einsum":
+                y = einsum_combine(expert_out, r, C_loc)
+            else:
+                y = sort_combine(expert_out, r, C_loc)
+
+            aux = jax.lax.pmean(r.aux, EP_AXIS)
+            z = jax.lax.pmean(r.z, EP_AXIS)
+            stats = jax.lax.pmean(r.stats, EP_AXIS)
+            # balance recomputed from the MEAN load shares so the scalar
+            # stays consistent with the loads the report renders
+            load = stats[len(STATS_FIELDS):]
+            stats = stats.at[2].set(
+                1.0 - 0.5 * jnp.sum(jnp.abs(load - 1.0 / E)))
+            return y, aux, z, stats
+
+        gate_specs = (P(),) * n_gate
+        prog = jax.jit(dist_env.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(EP_AXIS),) + gate_specs + (P(EP_AXIS),) * 4,
+            out_specs=(P(EP_AXIS), P(), P(), P()),
+            axis_names={EP_AXIS}, check_vma=False))
+        cache[ckey] = prog
+        return prog
+
+    # -- telemetry ---------------------------------------------------------
+    def _publish_stats(self):
+        """Always-on router telemetry (monitor-gated, like every hot-path
+        registry stream): publishes when the stats are CONCRETE — eager
+        forwards. Inside a jitted TrainStep the values are tracers; use
+        :func:`publish_router_stats` after an eager forward to harvest."""
+        stats = self.router_stats
+        if stats is None or isinstance(stats._data, jax.core.Tracer):
+            return
+        from ...monitor import enabled as _mon_enabled
+        if not _mon_enabled():
+            return
+        _publish_one(self, count_drops=True)
+
+
+def _guarded_ep_dispatch(n: int, prog, *args):
+    """Eager expert-parallel dispatches run under the PR 5 collective
+    watchdog (FLAGS_collective_timeout_s + chaos ``collective.hang``) so
+    a hung expert all_to_all raises CollectiveTimeoutError; traced calls
+    (inside an outer jit) bypass — the enclosing TrainStep guards its own
+    dispatch."""
+    if any(isinstance(a, jax.core.Tracer)
+           for a in jax.tree_util.tree_leaves(args)):
+        return prog(*args)
+    from ...distributed.collective import _run_collective
+    return _run_collective("moe.all_to_all", moe_ep_group(n), prog, *args)
+
+
+def _publish_row(stats_row, label: str, num_experts: int, registry=None,
+                 dropped_assignments=None):
+    """Publish one layer's router gauges from a raw stats row
+    ``[drop_frac, entropy, balance_frac, load_0..E-1]`` (numpy/float
+    values). Shared by MoELayer telemetry and GPTModel's scan-side-output
+    harvest."""
+    from ...monitor import get_registry
+    reg = registry or get_registry()
+    s = [float(v) for v in stats_row]
+    nf = len(STATS_FIELDS)
+    reg.gauge("moe_router_drop_pct",
+              "dropped (token, choice) assignments, % of T*k"
+              ).set(100.0 * s[0], layer=label)
+    reg.gauge("moe_router_entropy",
+              "mean per-token routing entropy (nats)"
+              ).set(s[1], layer=label)
+    reg.gauge("moe_router_balance_pct",
+              "expert-load balance: 100 * (1 - TV distance from "
+              "uniform); 100 = perfectly balanced").set(
+                  100.0 * s[2], layer=label)
+    for e, v in enumerate(s[nf:nf + num_experts]):
+        reg.gauge("moe_expert_load_share",
+                  "per-expert share of kept assignments").set(
+                      v, layer=label, expert=e)
+    if dropped_assignments is not None:
+        reg.counter("moe_dropped_tokens_total",
+                    "capacity-overflow-dropped (token, choice) "
+                    "assignments").inc(round(dropped_assignments),
+                                       layer=label)
+
+
+def _publish_one(layer: MoELayer, registry=None, count_drops=False):
+    import numpy as np
+    s = np.asarray(layer.router_stats._data, dtype=np.float64)
+    dropped = (float(s[0]) * layer._last_tokens * layer.top_k
+               if count_drops else None)
+    _publish_row(s, layer._label, layer.num_experts, registry,
+                 dropped_assignments=dropped)
+
+
+def publish_router_stats(model, registry=None) -> int:
+    """Walk ``model`` for MoE layers with CONCRETE router stats (i.e.
+    after an eager forward) and publish their ``moe_router_*`` gauges;
+    returns the number of layers published. The bench and
+    tools/monitor_report.py --moe consume the result."""
+    count = 0
+    layers = [model] if isinstance(model, MoELayer) else \
+        [l for _, l in model.named_sublayers(include_self=True)
+         if isinstance(l, MoELayer)]
+    for l in layers:
+        if l.router_stats is None or \
+                isinstance(l.router_stats._data, jax.core.Tracer):
+            continue
+        _publish_one(l, registry)
+        count += 1
+    return count

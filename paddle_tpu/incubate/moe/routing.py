@@ -1,0 +1,155 @@
+"""MoE routing: capacity-disciplined top-k gating over raw arrays.
+
+One router serves BOTH dispatch implementations (``dispatch.py``): the
+einsum oracle and the sort path consume the same per-(token, choice)
+decisions — expert index, capacity position, keep mask, normalized gate —
+so capacity clipping and drop decisions are identical by construction and
+the ``FLAGS_moe_dispatch`` kill switch changes only the data movement.
+
+Math (GShard eq. 2-4 / Switch Transformer §2.2):
+
+- probabilities: softmax over experts in f32 — the router is ALWAYS f32
+  even when the activation stream is bf16 (a half-precision router
+  misroutes near ties and destabilizes the aux losses);
+- top-k selection: iterated argmax with the chosen expert masked out
+  (k = 1 is Switch, k = 2 is GShard);
+- capacity positions: running per-expert count in token order, choice-
+  major priority — ALL first choices take capacity slots before any
+  second choice (the GShard discipline); a (token, choice) pair whose
+  position overflows ``capacity`` is dropped (its gate contributes 0);
+- gate weights: per-token renormalized over the SURVIVING choices;
+- aux loss (load balance, GShard eq. 4): E * Σ_e mean_t(top1_mask_e) *
+  mean_t(prob_e);
+- router z-loss (ST-MoE, Zoph et al. 2022): mean_t(logsumexp_e(logits)²)
+  — keeps router logits small so the f32 softmax stays well-conditioned.
+
+All outputs are f32 (integer-valued fields included): the eager tape
+synthesizes zero cotangents for unused outputs by output dtype, so a
+differentiable multi-output op must stay float-dtyped end to end; the
+dispatch fns cast indices to int32 internally.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Routing", "topk_routing", "top2_gating", "moe_capacity",
+           "STATS_FIELDS", "stats_fields"]
+
+#: layout of the per-layer router-stats vector ``Routing.stats``
+#: (prefix; followed by the E per-expert load shares): drop_frac = dropped
+#: (token, choice) assignments / (T*k); entropy = mean token routing
+#: entropy in nats; balance_frac = 1 - total-variation distance of the
+#: kept-assignment load from uniform (1.0 = perfectly balanced).
+STATS_FIELDS = ("drop_frac", "entropy", "balance_frac")
+
+
+def stats_fields(num_experts: int):
+    """Field names of a stats vector for E experts."""
+    return list(STATS_FIELDS) + [f"load_{e}" for e in range(num_experts)]
+
+
+class Routing(NamedTuple):
+    """Per-(choice, token) routing decisions, all f32, choice-major.
+
+    ``gates``/``idx``/``pos``/``keep``: [k, T]; ``aux``/``z``: scalars;
+    ``stats``: [len(STATS_FIELDS) + E].
+    """
+    gates: jax.Array
+    idx: jax.Array
+    pos: jax.Array
+    keep: jax.Array
+    aux: jax.Array
+    z: jax.Array
+    stats: jax.Array
+
+
+def moe_capacity(tokens: int, capacity_factor: float,
+                 num_experts: int) -> int:
+    """Fixed per-expert capacity: ceil(T * cf / E), floored at 4 (the
+    GShard/Switch convention; tiny batches still give every expert a
+    non-degenerate slot count)."""
+    return max(4, int(math.ceil(tokens * capacity_factor / num_experts)))
+
+
+def topk_routing(logits, top_k: int, capacity: int) -> Routing:
+    """Route ``logits`` [T, E] to ``top_k`` experts with fixed capacity.
+
+    Raw-array function (call inside ``apply``/jit). For ``top_k == 2``
+    the selection/position/gate arithmetic reproduces the legacy
+    ``top2_gating`` bit for bit — that function is now a thin wrapper.
+    """
+    T, E = logits.shape
+    if not 1 <= top_k <= E:
+        raise ValueError(f"top_k={top_k} outside [1, {E}]")
+    lf = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(lf, axis=-1)
+
+    masks, idxs = [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)                     # [T]
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [T, E]
+        idxs.append(idx)
+        masks.append(m)
+        p = p * (1.0 - m)
+
+    # capacity positions: token order within each expert, choice-major
+    # priority (choice i's tokens claim slots after every choice < i)
+    pos_scalar, keeps = [], []
+    offset = None                                        # [E] running count
+    for m in masks:
+        base = jnp.cumsum(m, axis=0) - 1.0
+        pm = (base if offset is None else base + offset) * m
+        keeps.append(m * (pm < capacity))
+        pos_scalar.append(pm.sum(-1))                    # [T]
+        offset = m.sum(0) if offset is None else offset + m.sum(0)
+
+    gates = [(probs * kp).sum(-1) for kp in keeps]       # [T] each
+    denom = gates[0]
+    for g in gates[1:]:
+        denom = denom + g
+    denom = jnp.maximum(denom, 1e-9)
+    gates = [g / denom for g in gates]
+
+    # aux loss (GShard eq. 4) over the TOP-1 assignment
+    frac_tokens = masks[0].mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    # router z-loss (ST-MoE): squared logsumexp of the raw logits
+    z = jnp.mean(jax.nn.logsumexp(lf, axis=-1) ** 2)
+
+    # routing-health stats
+    kept_e = keeps[0].sum(0)
+    for kp in keeps[1:]:
+        kept_e = kept_e + kp.sum(0)                      # [E]
+    total_kept = kept_e.sum()
+    load = kept_e / jnp.maximum(total_kept, 1.0)
+    drop_frac = 1.0 - total_kept / float(T * top_k)
+    entropy = jnp.mean(-jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1))
+    balance = 1.0 - 0.5 * jnp.sum(jnp.abs(load - 1.0 / E))
+    stats = jnp.concatenate([
+        jnp.stack([drop_frac, entropy, balance]), load]).astype(jnp.float32)
+
+    keep_scalar = [jnp.minimum(kp.sum(-1), 1.0) for kp in keeps]
+    return Routing(
+        gates=jnp.stack(gates).astype(jnp.float32),
+        idx=jnp.stack(idxs).astype(jnp.float32),
+        pos=jnp.stack(pos_scalar).astype(jnp.float32),
+        keep=jnp.stack(keep_scalar).astype(jnp.float32),
+        aux=aux, z=z, stats=stats)
+
+
+def top2_gating(logits, capacity: int):
+    """GShard top-2 gating -> (combine [T, E, C], dispatch bool [T, E, C],
+    aux_loss). Legacy surface kept for parity consumers; the combine/
+    dispatch tensors are built from :func:`topk_routing`'s decisions with
+    the original arithmetic (``dispatch.combine_tensor``)."""
+    from .dispatch import combine_tensor
+    r = topk_routing(logits, 2, capacity)
+    combine = combine_tensor(r, logits.shape[1], capacity)
+    return combine, combine > 0.0, r.aux

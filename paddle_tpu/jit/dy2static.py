@@ -508,7 +508,12 @@ def __jst_if__(test, true_fn, false_fn, vals, names, both=()):
         try:
             return snn.cond(test, true_fn, false_fn, *clean)
         except TypeError as e:
-            if "pytree structure" not in str(e):
+            # jax has spelled the branch-structure mismatch two ways:
+            # "pytree structure" (newer) and "same type structure ...
+            # PyTreeDef" (0.4.x) — both mean the same recoverable shape
+            msg = str(e)
+            if "pytree structure" not in msg and \
+                    "type structure" not in msg:
                 raise
             # Structure mismatch — typically a return-transform carry
             # whose initial value is None on one side and a tensor on the

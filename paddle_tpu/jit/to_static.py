@@ -424,25 +424,50 @@ class TrainStep:
                                           sub._pp_degree())
         except Exception:
             pass
+        # same guard for models carrying expert-parallel MoE layers over
+        # an ep>1 mesh: the step program contains the expert all_to_alls
+        # (ISSUE 10 — a hung expert exchange must raise structured)
+        self._ep_degree = 0
+        if not self._pp_degree:
+            try:
+                from ..incubate.moe import MoELayer
+                for sub in layer.sublayers(include_self=True):
+                    if isinstance(sub, MoELayer) and sub._stacked:
+                        self._ep_degree = max(self._ep_degree,
+                                              sub._ep_degree())
+            except Exception:
+                pass
 
     def _dispatch(self, jitted, *args):
-        """Invoke a compiled step program; pipeline-carrying steps run
-        under the collective watchdog (zero overhead with the timeout
-        flag unset and no chaos armed)."""
+        """Invoke a compiled step program; pipeline- and expert-parallel-
+        carrying steps run under the collective watchdog (zero overhead
+        with the timeout flag unset and no chaos armed)."""
         if self._pp_degree > 1:
             from ..distributed import collective as _coll
             from ..distributed.meta_parallel.spmd_pipeline import _pp_group
             return _coll._run_collective(
                 "pipeline_step", _pp_group(self._pp_degree), jitted, *args)
+        if self._ep_degree > 1:
+            from ..distributed import collective as _coll
+            from ..incubate.moe import moe_ep_group
+            return _coll._run_collective(
+                "moe_step", moe_ep_group(self._ep_degree), jitted, *args)
         return jitted(*args)
 
     # -- SPMD layout -------------------------------------------------------
     def _param_specs(self):
         from jax.sharding import PartitionSpec as P
+
+        from ..distributed.spmd import degrade_spec
         specs = {}
         for k, p in self.layer.named_parameters():
             if k in self.params:
-                specs[k] = getattr(p, "spec", None) or P()
+                spec = getattr(p, "spec", None) or P()
+                # spec axes absent from THIS mesh degrade to replicated —
+                # e.g. mp-annotated weights on an ep-only mesh
+                if self.mesh is not None:
+                    spec = degrade_spec(spec, self.mesh)
+                specs[k] = spec
         return specs
 
     def _slot_spec(self, k, shape):

@@ -54,7 +54,8 @@ from ..nn.scan import (can_scan_layers, note_scan_fallback, scan_layers,
                        scan_layers_with_cache)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
-           "GPTPretrainingCriterion", "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_xl"]
+           "GPTPretrainingCriterion", "GPTMoEDecoderLayer",
+           "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_xl"]
 
 MP = "mp"
 SP = "sp"
@@ -83,6 +84,22 @@ class GPTConfig:
     #: KV-cache decoding or heterogeneous stacks.
     scan_layers: bool = True
     sequence_parallel: bool = False
+    #: Mixture-of-Experts (ISSUE 10, docs/MOE.md): moe_experts > 0 swaps
+    #: the FFN of every ``moe_every``-th decoder layer (layer i is MoE
+    #: iff (i+1) % moe_every == 0; moe_every=1 = every layer, the
+    #: homogeneous stack that scans as ONE lax.scan) for an
+    #: incubate.moe.MoELayer with ``moe_experts`` stacked ExpertFFN
+    #: experts (hidden = ffn_size), top-``moe_top_k`` routing at
+    #: ``moe_capacity_factor``. The router aux/z losses are weighted by
+    #: moe_aux_weight/moe_z_weight into ``GPTModel.moe_loss()``; add it
+    #: to the CE in the training loss_fn. Dense layer state_dict names
+    #: are unchanged; MoE layers add ``layers.<i>.moe.*`` leaves.
+    moe_experts: int = 0
+    moe_every: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
+    moe_z_weight: float = 1e-3
 
     @property
     def ffn_size(self) -> int:
@@ -91,6 +108,13 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    def moe_layer_indices(self):
+        """Decoder-layer indices that carry an MoE FFN."""
+        if not self.moe_experts:
+            return []
+        k = max(1, int(self.moe_every))
+        return [i for i in range(self.num_layers) if (i + 1) % k == 0]
 
 
 def _mesh():
@@ -313,9 +337,17 @@ class GPTDecoderLayer(Layer):
         self.ln1 = LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln2 = LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        self._build_ffn(cfg)
         self.dropout1 = Dropout(cfg.hidden_dropout_prob)
         self.dropout2 = Dropout(cfg.hidden_dropout_prob)
+
+    def _build_ffn(self, cfg: GPTConfig):
+        self.mlp = GPTMLP(cfg)
+
+    def _ffn(self, h):
+        """The block's feed-forward half (GPTMoEDecoderLayer swaps in
+        the expert mixture)."""
+        return self.mlp(h)
 
     def forward(self, x, cache=None, pos=None):
         sp = _seq_spec(self.cfg)
@@ -326,10 +358,37 @@ class GPTDecoderLayer(Layer):
         x = x + self.dropout1(a)
         if sp:
             x = _constrain(x, BATCH, sp, None)
-        x = x + self.dropout2(self.mlp(self.ln2(x)))
+        x = x + self.dropout2(self._ffn(self.ln2(x)))
         if sp:
             x = _constrain(x, BATCH, sp, None)
         return x if cache is None else (x, cache)
+
+
+class GPTMoEDecoderLayer(GPTDecoderLayer):
+    """Pre-LN block whose FFN is a mixture of experts (incubate.moe).
+
+    Forward contract: without a cache it returns ``(x, moe_vec)`` where
+    ``moe_vec`` is the layer's [aux, z, drop, entropy, balance,
+    load_0..E-1] f32 vector — GPTModel collects these (as scan side
+    outputs for homogeneous stacks) into ``moe_loss()`` and the router
+    telemetry; with a cache it returns ``(x, cache)`` exactly like the
+    dense layer, so every decode path is unchanged."""
+
+    def _build_ffn(self, cfg: GPTConfig):
+        from ..incubate.moe import MoELayer
+        self.moe = MoELayer(
+            cfg.hidden_size, num_experts=cfg.moe_experts,
+            d_hidden=cfg.ffn_size, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor)
+
+    def _ffn(self, h):
+        return self.moe(h)
+
+    def forward(self, x, cache=None, pos=None):
+        out = super().forward(x, cache, pos=pos)
+        if cache is not None:
+            return out                    # (x, cache) — decode unchanged
+        return out, self.moe.moe_vec
 
 
 def _paged_scan_body(template, x, cache_slices, extras):
@@ -361,8 +420,18 @@ class GPTModel(Layer):
             0.0, cfg.initializer_range)(
             (cfg.max_position_embeddings, cfg.hidden_size), "float32")
         self.embedding_dropout = Dropout(cfg.hidden_dropout_prob)
-        self.layers = LayerList([GPTDecoderLayer(cfg)
-                                 for _ in range(cfg.num_layers)])
+        moe_idx = set(cfg.moe_layer_indices())
+        if cfg.moe_experts and not moe_idx:
+            raise ValueError(
+                f"moe_experts={cfg.moe_experts} but moe_every="
+                f"{cfg.moe_every} places no MoE layer in a "
+                f"{cfg.num_layers}-layer stack (layer i is MoE iff "
+                "(i+1) % moe_every == 0)")
+        self.layers = LayerList([
+            GPTMoEDecoderLayer(cfg) if i in moe_idx else
+            GPTDecoderLayer(cfg) for i in range(cfg.num_layers)])
+        for i in sorted(moe_idx):
+            self.layers[i].moe._label = f"layer{i}"
         self.final_norm = LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, position_ids=None, caches=None,
@@ -405,16 +474,34 @@ class GPTModel(Layer):
                 "into the fixed-size KV buffers); models/generation.py "
                 "threads it automatically")
         new_caches = [] if caches is not None else None
+        if caches is None:
+            self.__dict__["_moe_vecs"] = None
+        moe_stack = bool(self.cfg.moe_experts) and caches is None
         if caches is None and self.cfg.scan_layers \
                 and can_scan_layers(self.layers):
             # one lax.scan over the layer-stacked params: the block body
             # traces/compiles once regardless of depth; selective remat
-            # composes inside the scanned body
-            x = scan_layers(
-                self.layers, x,
-                use_recompute=self.cfg.use_recompute and self.training,
-                policy=self.cfg.recompute_policy,
-                name="gpt_scan_layers")
+            # composes inside the scanned body. A homogeneous MoE stack
+            # (moe_every=1) threads its per-layer router vectors out of
+            # the scan as side outputs (nn.scan num_aux).
+            all_moe = isinstance(self.layers[0], GPTMoEDecoderLayer)
+            if all_moe:
+                from ..core.flags import get_flag as _gf
+                x, vecs = scan_layers(
+                    self.layers, x,
+                    use_recompute=self.cfg.use_recompute and self.training,
+                    policy=self.cfg.recompute_policy, num_aux=1,
+                    token_extra=(str(_gf("moe_dispatch")),
+                                 bool(_gf("moe_expert_parallel")),
+                                 int(_gf("moe_a2a_chunks"))),
+                    name="gpt_moe_scan_layers")
+                self.__dict__["_moe_vecs"] = vecs          # [L, 5+E]
+            else:
+                x = scan_layers(
+                    self.layers, x,
+                    use_recompute=self.cfg.use_recompute and self.training,
+                    policy=self.cfg.recompute_policy,
+                    name="gpt_scan_layers")
         else:
             if caches is not None and self.cfg.scan_layers \
                     and can_scan_layers(self.layers):
@@ -423,16 +510,78 @@ class GPTModel(Layer):
                 # layout (paddle_tpu.serving) can — make the silent
                 # degradation loud (ISSUE 6 satellite)
                 note_scan_fallback("legacy_static_cache", "gpt")
+            vecs = []
             for i, blk in enumerate(self.layers):
+                is_moe = isinstance(blk, GPTMoEDecoderLayer)
                 if caches is not None:
                     x, c = blk(x, caches[i], pos=cache_pos)
                     new_caches.append(c)
-                elif self.cfg.use_recompute and self.training:
-                    x = recompute(blk, x, policy=self.cfg.recompute_policy)
+                    continue
+                if self.cfg.use_recompute and self.training:
+                    out = recompute(blk, x, policy=self.cfg.recompute_policy)
                 else:
-                    x = blk(x)
+                    out = blk(x)
+                if is_moe:
+                    x, vec = out
+                    vecs.append(vec)
+                else:
+                    x = out
+            if moe_stack and vecs:
+                from ..tensor.manipulation import stack as tstack
+                self.__dict__["_moe_vecs"] = tstack(vecs, axis=0)
+        if moe_stack:
+            self._reduce_moe_loss()
         x = self.final_norm(x)
         return x if caches is None else (x, new_caches)
+
+    # -- MoE side channel --------------------------------------------------
+    def _reduce_moe_loss(self):
+        """Weighted router losses of the last no-cache forward: aux (load
+        balance) + z (logit magnitude), summed over MoE layers. Same-trace
+        value — consume it in the SAME loss computation that ran the
+        forward (TrainStep loss_fns do)."""
+        vecs = self.__dict__.get("_moe_vecs")
+        if vecs is None:
+            self.__dict__["_moe_loss"] = None
+            return
+        w_a = float(self.cfg.moe_aux_weight)
+        w_z = float(self.cfg.moe_z_weight)
+        self.__dict__["_moe_loss"] = apply(
+            lambda v: (w_a * v[:, 0].sum()
+                       + w_z * v[:, 1].sum()).astype(jnp.float32),
+            vecs, name="gpt_moe_loss")
+
+    def moe_loss(self):
+        """Weighted MoE router loss (aux + z) of the last forward, or
+        None for dense configs. Add it to the CE in the loss_fn:
+        ``crit(logits, labels) + model.gpt.moe_loss()``."""
+        return self.__dict__.get("_moe_loss")
+
+    def moe_layer_stats(self):
+        """Per-MoE-layer router vectors [L_moe, 5+E] of the last no-cache
+        forward (Tensor), or None. Rows follow
+        ``cfg.moe_layer_indices()`` order; columns are [aux, z, drop,
+        entropy, balance, load_0..E-1]."""
+        return self.__dict__.get("_moe_vecs")
+
+    def publish_moe_telemetry(self, registry=None) -> int:
+        """Publish per-layer router gauges (balance/drop/entropy/loads)
+        from the last EAGER forward into the monitor registry; returns
+        the number of layers published (0 when the last forward was
+        traced — run one eager forward to harvest).
+        tools/monitor_report.py --moe renders the result."""
+        import jax as _jax
+        import numpy as np
+        vecs = self.__dict__.get("_moe_vecs")
+        if vecs is None or isinstance(vecs._data, _jax.core.Tracer):
+            from ..incubate.moe import publish_router_stats
+            return publish_router_stats(self, registry)
+        from ..incubate.moe.layer import _publish_row
+        arr = np.asarray(vecs._data)
+        E = self.cfg.moe_experts
+        for row, i in zip(arr, self.cfg.moe_layer_indices()):
+            _publish_row(row[2:], f"layer{i}", E, registry)
+        return arr.shape[0]
 
     def _forward_paged(self, x, caches, cache_pos):
         """Run the stack over a paged KV view: under scan
@@ -523,6 +672,11 @@ class GPTForPretraining(Layer):
                 new_caches
         return parallel_logits(out, self.gpt.word_embeddings.weight)
 
+    def moe_loss(self):
+        """Weighted MoE router loss of the last forward (see
+        GPTModel.moe_loss), or None for dense configs."""
+        return self.gpt.moe_loss()
+
     def generate(self, input_ids, max_new_tokens=32, **kwargs):
         """Autoregressive decoding with a static KV cache (see
         models/generation.py)."""
@@ -597,6 +751,12 @@ class GPTForPretrainingPipe(Layer):
         super().__init__()
         from ..distributed.meta_parallel.spmd_pipeline import (
             PipelineStageStack)
+        if cfg.moe_experts:
+            raise NotImplementedError(
+                "GPTForPretrainingPipe does not support MoE configs yet "
+                "(the pipeline stage stack builds dense decoder layers); "
+                "use GPTForPretraining — MoE composes with DP/EP/TP, the "
+                "pp schedule is an open item (docs/MOE.md)")
         self.cfg = cfg
         self.word_embeddings = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size)

@@ -191,6 +191,7 @@ def can_scan_layers(blocks) -> bool:
 
 
 def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
+                num_aux: int = 0, token_extra=None,
                 name: str = "scan_layers"):
     """Run ``x`` through ``blocks`` sequentially via one ``jax.lax.scan``.
 
@@ -201,9 +202,18 @@ def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
     ``fleet.utils.recompute.resolve_checkpoint_policy``) for selective
     remat; only applied when ``use_recompute``.
 
-    Returns the final hidden states Tensor. Equivalent to
-    ``for b in blocks: x = b(x, *extra)`` up to float reassociation (and
-    dropout-mask realization when training with dropout).
+    ``num_aux``: when > 0, each block's forward returns ``(x, aux_1, ...,
+    aux_{num_aux})`` and the per-layer aux values leave the scan as
+    scanned-over outputs stacked ``[L, ...]`` — the side channel MoE
+    stacks use for per-layer router losses/stats (a value produced
+    inside the scan body can only escape as a scan output; storing it on
+    the layer would leak a body tracer). The call then returns
+    ``(y, aux_1_stacked, ..., aux_n_stacked)``.
+
+    Returns the final hidden states Tensor (or the tuple above).
+    Equivalent to ``for b in blocks: x = b(x, *extra)`` up to float
+    reassociation (and dropout-mask realization when training with
+    dropout).
     """
     from ..distributed.fleet.utils.recompute import resolve_checkpoint_policy
     from ..jit.functional import bind
@@ -267,16 +277,23 @@ def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
                 out = template(Tensor(carry),
                                *[Tensor(e) if hasattr(e, "dtype") else e
                                  for e in extra_raw])
+            aux_raw = None
+            if num_aux:
+                out, aux = out[0], tuple(out[1:1 + num_aux])
+                aux_raw = tuple(a._data if isinstance(a, Tensor) else a
+                                for a in aux)
             out = out._data if isinstance(out, Tensor) else out
-            return out.astype(carry.dtype), None
+            return out.astype(carry.dtype), aux_raw
 
         if use_recompute:
             # prevent_cse=False: inside scan the loop structure already
             # rules out the CSE hazard jax.checkpoint guards against
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-        y, _ = jax.lax.scan(
+        y, ys = jax.lax.scan(
             body, x_arr,
             (p_stacked, jnp.arange(num_layers, dtype=jnp.int32)))
+        if num_aux:
+            return (y,) + tuple(ys)
         return y
 
     x_t = x if isinstance(x, Tensor) else Tensor(x)
@@ -290,9 +307,13 @@ def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
     # _config_sig(template) rides in the token so an IN-PLACE config edit
     # (e.g. setting every layer's dropout p) changes the key and retraces —
     # a cached trace must never replay stale config values
+    # token_extra: hashable caller-supplied material for flag-dependent
+    # block internals the config signature cannot see (e.g. the MoE
+    # dispatch-mode kill switch — a cached trace must never replay a
+    # stale dispatch path)
     token = ("scan_layers", name, id(template), num_layers, training,
-             bool(use_recompute), policy_tok, len(extra),
-             _config_sig(template))
+             bool(use_recompute), policy_tok, len(extra), num_aux,
+             token_extra, _config_sig(template))
     return apply(_scan_fn, x_t, *key_args, *flat_params, *extra, name=name,
                  _cache_token=token)
 

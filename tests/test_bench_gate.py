@@ -144,3 +144,35 @@ def test_bubble_unit_gates_on_absolute_points_growth():
     assert check_bench.compare(old, ok, tolerance=0.10) == []
     problems = check_bench.compare(old, bad, tolerance=0.10)
     assert len(problems) == 1 and "+20.0 points" in problems[0]
+
+
+def test_moe_balance_unit_gates_on_absolute_points_drop():
+    """balance (MoE expert-load balance, BENCH_moe) is higher-is-better
+    on ABSOLUTE points: a near-100 healthy baseline must trip when
+    routing collapses onto few experts (a relative band would hide a
+    9-point loss), and an improvement never trips."""
+    old = [_m("moe_gpt2_tiny_8e_balance", 95.0, "balance")]
+    ok = [_m("moe_gpt2_tiny_8e_balance", 87.0, "balance")]    # -8 pts
+    bad = [_m("moe_gpt2_tiny_8e_balance", 80.0, "balance")]   # -15 pts
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "-15.0 points" in problems[0]
+    up = [_m("moe_gpt2_tiny_8e_balance", 99.0, "balance")]
+    assert check_bench.compare(old, up, tolerance=0.10) == []
+
+
+def test_moe_drop_unit_gates_on_absolute_points_growth():
+    """drop% (MoE dropped-assignment share) regresses when it GROWS, on
+    absolute points — the healthy 0% baseline stays gateable (a
+    relative gate can never fire off a 0 baseline)."""
+    old = [_m("moe_gpt2_tiny_8e_drop_pct", 0.0, "drop%")]
+    ok = [_m("moe_gpt2_tiny_8e_drop_pct", 8.0, "drop%")]
+    bad = [_m("moe_gpt2_tiny_8e_drop_pct", 25.0, "drop%")]
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "+25.0 points" in problems[0]
+    # direction: fewer drops never trips
+    down = [_m("moe_gpt2_tiny_8e_drop_pct", 0.0, "drop%")]
+    assert check_bench.compare(
+        [_m("moe_gpt2_tiny_8e_drop_pct", 10.0, "drop%")], down,
+        tolerance=0.10) == []

@@ -1,0 +1,292 @@
+"""MoE-GPT integration tests (ISSUE 10 tentpole 3).
+
+Pins: GPTConfig(moe_experts, moe_every) wiring, the homogeneous-MoE
+scan-over-layers compile discipline (ONE body trace / zero warm
+retraces via CompileCounter), the kill-switch-through-cache contract
+(flipping FLAGS_moe_dispatch retraces into the other path), mixed-stack
+loop collection, state_dict stability, CheckpointManager bit-exact
+resume, decode-path compatibility, and the monitor_report --moe render.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.core.tensor import Tensor, no_grad
+from paddle_tpu.incubate.moe import MOE_STATS, reset_moe_stats
+from paddle_tpu.jit.to_static import TrainStep
+from paddle_tpu.models.gpt import (GPTForPretraining, GPTMoEDecoderLayer,
+                                   GPTPretrainingCriterion, gpt_tiny)
+from paddle_tpu.nn.scan import SCAN_STATS, reset_scan_stats
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.utils import CompileCounter
+
+
+@pytest.fixture(autouse=True)
+def _moe_isolation():
+    reset_moe_stats()
+    reset_scan_stats()
+    yield
+    reset_moe_stats()
+    from paddle_tpu.distributed import env as dist_env
+    dist_env.reset()
+
+
+def _batch(cfg, B=4, S=32, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    return ids, labels
+
+
+def _build_step(cfg, seed=0, lr=1e-3):
+    paddle.seed(seed)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels) + layer.moe_loss()
+
+    step = TrainStep(model, loss_fn,
+                     AdamW(learning_rate=lr,
+                           parameters=model.parameters()))
+    return model, step
+
+
+def test_moe_layer_indices():
+    assert gpt_tiny(num_layers=4).moe_layer_indices() == []
+    assert gpt_tiny(num_layers=4, moe_experts=4).moe_layer_indices() \
+        == [0, 1, 2, 3]
+    assert gpt_tiny(num_layers=6, moe_experts=4,
+                    moe_every=2).moe_layer_indices() == [1, 3, 5]
+    assert gpt_tiny(num_layers=6, moe_experts=4,
+                    moe_every=3).moe_layer_indices() == [2, 5]
+
+
+def test_homogeneous_moe_stack_scans_one_trace_and_trains():
+    """Acceptance: a homogeneous MoE stack under scan-over-layers pins
+    ONE body trace on the cold step and ZERO retraces/compiles warm,
+    and the train loss decreases with the router losses in the mix."""
+    cfg = gpt_tiny(num_layers=4, moe_experts=8)
+    model, step = _build_step(cfg)
+    ids, labels = _batch(cfg)
+    reset_scan_stats()
+    l0 = float(step(ids, labels))
+    assert SCAN_STATS["body_traces"] == 1      # one trace, not O(L)
+    assert SCAN_STATS["fallbacks"] == 0
+    with CompileCounter() as c:
+        losses = [float(step(ids, labels)) for _ in range(5)]
+    assert c.backend_compiles == 0 and c.jaxpr_traces == 0
+    assert losses[-1] < l0
+    assert all(np.isfinite(v) for v in [l0] + losses)
+
+
+def test_dispatch_kill_switch_retraces_through_scan_cache():
+    """The dispatch mode rides the scan's eager-cache token: flipping
+    FLAGS_moe_dispatch must RETRACE into the other path (a cached trace
+    must never replay a stale dispatch), pinned via the MOE_STATS
+    dispatch counters which only move at trace time."""
+    cfg = gpt_tiny(num_layers=2, moe_experts=4)
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    ids, _ = _batch(cfg, B=2, S=16)
+    # grad-enabled forwards: the eager jit cache only serves recorded
+    # ops (a no_grad forward re-runs the python body every call)
+    with flag_scope("moe_dispatch", "sort"):
+        model(paddle.to_tensor(ids))
+        n_sort = MOE_STATS["sort_dispatches"]
+        assert n_sort >= 1
+        model(paddle.to_tensor(ids))           # warm: no new body trace
+        assert MOE_STATS["sort_dispatches"] == n_sort
+    with flag_scope("moe_dispatch", "einsum"):
+        model(paddle.to_tensor(ids))
+        assert MOE_STATS["einsum_dispatches"] >= 1
+
+
+def test_mixed_stack_loop_collects_stats_and_loss():
+    """moe_every=2 (heterogeneous stack): the python loop collects
+    per-MoE-layer vectors, moe_loss() is finite and differentiable, and
+    publish_moe_telemetry lands per-layer gauges."""
+    from paddle_tpu.monitor import scoped_registry
+
+    cfg = gpt_tiny(num_layers=4, moe_experts=4, moe_every=2)
+    paddle.seed(1)
+    model = GPTForPretraining(cfg)
+    ids, labels = _batch(cfg, B=2, S=16)
+    out = model(paddle.to_tensor(ids))
+    assert tuple(out.shape) == (2, 16, cfg.vocab_size)
+    stats = model.gpt.moe_layer_stats()
+    assert tuple(stats.shape) == (2, 5 + 4)          # layers 1, 3
+    assert float(model.moe_loss()) > 0
+    with scoped_registry() as reg:
+        assert model.gpt.publish_moe_telemetry() == 2
+        g = reg.get("moe_router_balance_pct")
+        layers = {dict(lbl)["layer"] for lbl, _ in g.samples()}
+        assert layers == {"layer1", "layer3"}
+
+    # trains end to end through TrainStep (loop path in the trace)
+    model2, step = _build_step(cfg, seed=1)
+    losses = [float(step(ids, labels)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_state_dict_names_and_bit_exact_roundtrip():
+    """Dense-layer state_dict names are UNCHANGED by the MoE wiring;
+    MoE layers add layers.<i>.moe.* leaves; a save->load roundtrip into
+    a fresh model reproduces the forward bit-for-bit."""
+    cfg = gpt_tiny(num_layers=4, moe_experts=4, moe_every=2)
+    paddle.seed(2)
+    model = GPTForPretraining(cfg)
+    names = set(model.state_dict().keys())
+    # dense layers (0, 2) keep the classic mlp names
+    assert "gpt.layers.0.mlp.w_in" in names
+    assert "gpt.layers.2.mlp.w_out" in names
+    # MoE layers (1, 3) carry the expert stack + gate
+    assert "gpt.layers.1.moe.experts.w1" in names
+    assert "gpt.layers.3.moe.gate.weight" in names
+    assert "gpt.layers.1.mlp.w_in" not in names
+
+    ids, _ = _batch(cfg, B=2, S=16)
+    with no_grad():
+        ref = np.asarray(model(paddle.to_tensor(ids))._data)
+    paddle.seed(99)                                  # different init
+    fresh = GPTForPretraining(cfg)
+    fresh.set_state_dict(model.state_dict())
+    with no_grad():
+        got = np.asarray(fresh(paddle.to_tensor(ids))._data)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_checkpoint_manager_resume_bit_exact(tmp_path):
+    """Acceptance: CheckpointManager resume of an MoE GPT is bit-exact —
+    the interrupted run's remaining loss trajectory equals the
+    uninterrupted reference exactly."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    cfg = gpt_tiny(num_layers=2, moe_experts=4)
+    root = str(tmp_path / "ckpts")
+    ids, labels = _batch(cfg, B=2, S=16)
+
+    _, step_ref = _build_step(cfg, seed=5)
+    ref = [float(step_ref(ids, labels)) for _ in range(6)]
+
+    _, step_a = _build_step(cfg, seed=5)
+    with CheckpointManager(step_a, root, interval_steps=2,
+                           keep_n=2) as mgr:
+        got_a = []
+        for i in range(4):
+            got_a.append(float(step_a(ids, labels)))
+            mgr.on_step(dataloader_state={"offset": i + 1})
+    assert got_a == ref[:4]
+
+    _, step_b = _build_step(cfg, seed=5)
+    with CheckpointManager(step_b, root, interval_steps=2,
+                           keep_n=2) as mgr:
+        info = mgr.resume()
+        assert info["step"] == 4
+        got_b = [float(step_b(ids, labels)) for _ in range(2)]
+    assert got_b == ref[4:]
+
+
+def test_moe_gpt_static_cache_decode_matches_full_forward():
+    """Greedy decode through the static-KV cache path (MoE layers return
+    (x, cache) there, stats suppressed) matches argmax over the full
+    forward recomputation token for token. Capacity is ample (cf=E) so
+    no assignment drops: MoE routing is capacity-coupled across the
+    tokens routed together, so drop decisions legitimately differ
+    between a whole-sequence forward and one-token decode chunks —
+    dropless is the regime where the two must agree exactly."""
+    cfg = gpt_tiny(num_layers=2, moe_experts=4,
+                   moe_capacity_factor=4.0)
+    paddle.seed(6)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    with no_grad():
+        out = model.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                             decode_strategy="greedy_search")
+        got = np.asarray(out._data if hasattr(out, "_data") else out)
+        # reference: greedy over full recomputation
+        cur = prompt.copy()
+        for _ in range(6):
+            logits = model(paddle.to_tensor(cur))
+            nxt = int(np.argmax(np.asarray(logits._data)[0, -1]))
+            cur = np.concatenate(
+                [cur, np.array([[nxt]], np.int32)], axis=1)
+    np.testing.assert_array_equal(got[:, :cur.shape[1]], cur)
+
+
+def test_monitor_report_moe_renders_per_layer_table(tmp_path):
+    """tools/monitor_report.py --moe renders the router-health table
+    from a registry dump."""
+    import importlib.util
+    import os
+    import sys
+
+    from paddle_tpu.monitor import scoped_registry
+
+    cfg = gpt_tiny(num_layers=2, moe_experts=4)
+    paddle.seed(7)
+    model = GPTForPretraining(cfg)
+    ids, _ = _batch(cfg, B=2, S=16)
+    with no_grad():
+        model(paddle.to_tensor(ids))
+    with scoped_registry() as reg:
+        assert model.gpt.publish_moe_telemetry() == 2
+        path = str(tmp_path / "mon.jsonl")
+        reg.dump_jsonl(path)
+
+    spec = importlib.util.spec_from_file_location(
+        "monitor_report", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "monitor_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from paddle_tpu.monitor import load_jsonl
+    text = mod.render(load_jsonl(path), moe=True)
+    assert "MoE router health" in text
+    assert "layer0" in text and "layer1" in text
+    assert "balance%" in text and "drop%" in text
+
+
+@pytest.mark.multichip
+@pytest.mark.chaos
+def test_trainstep_moe_ep_watchdog_raises_structured():
+    """TrainStep applies the collective watchdog to its whole step
+    program when the model carries expert-parallel MoE layers over an
+    ep>1 mesh: a chaos hang at the step dispatch raises structured."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed import collective as C, env as dist_env
+    from paddle_tpu.distributed.spmd import make_mesh
+    from paddle_tpu.testing import chaos
+
+    mesh = make_mesh({"ep": 8})
+    dist_env.set_mesh(mesh)
+    cfg = gpt_tiny(num_layers=2, moe_experts=8)
+    paddle.seed(8)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(layer, ids, labels):
+        return crit(layer(ids), labels) + layer.moe_loss()
+
+    step = TrainStep(model, loss_fn, AdamW(learning_rate=1e-3),
+                     mesh=mesh, data_spec=P("ep"))
+    assert step._ep_degree == 8
+    ids, labels = _batch(cfg, B=8, S=16)
+    # compile AND the step-2 sharding-drift re-lower (the PR 4 AOT
+    # self-heal recompiles once when XLA re-shards updated params)
+    # happen OUTSIDE the watchdog budget
+    float(step(ids, labels))
+    float(step(ids, labels))
+    with flag_scope("collective_timeout_s", 10.0):
+        float(step(ids, labels))               # healthy guarded dispatch
+        chaos.arm("collective.hang", at=1)
+        with pytest.raises(C.CollectiveTimeoutError) as exc:
+            step(ids, labels)
+    assert exc.value.op == "moe_step"
+    assert exc.value.group_axis == "ep"
+    assert exc.value.timeout_s == 10.0

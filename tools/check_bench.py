@@ -36,12 +36,16 @@ _TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
 # is undefined and the v_old==0 skip would otherwise make the metric
 # ungateable ("%" alone stays rate-like and relative:
 # serve_availability_pct regresses when it shrinks). bubble% is the
-# pipeline-schedule idle share (MULTICHIP record) — same shape.
-_ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%"}
+# pipeline-schedule idle share (MULTICHIP record); drop% is the MoE
+# router's dropped-assignment share (BENCH_moe) — same shape, healthy
+# baseline 0.
+_ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%"}
 # bounded 0-100 QUALITY rates (a drop is the regression), also gated on
 # absolute points: weak-scaling efficiency sits near 100, where the
-# relative 10% band would hide a 9-point efficiency loss
-_ABS_POINT_HIGHER_UNITS = {"weak%"}
+# relative 10% band would hide a 9-point efficiency loss; balance is the
+# MoE expert-load balance (100 = uniform), gated the same way so
+# BENCH_moe trips on routing-health collapse, not just throughput.
+_ABS_POINT_HIGHER_UNITS = {"weak%", "balance"}
 
 
 def _metric_list(record) -> List[dict]:

@@ -20,6 +20,10 @@ stream — prints:
   by construction) plus the pipeline schedule's comm-model gauges
   (``pipeline_comm_ops_per_step`` / ``pipeline_bubble_fraction``,
   docs/PARALLELISM.md);
+- with ``--moe``: the MoE router-health view — a per-layer table of the
+  ``moe_router_*`` gauges (balance/drop/entropy + per-expert load
+  spread), the dropped-token counter, and expert-parallel fallback
+  counts (docs/MOE.md; rendered next to the --comms output);
 - with ``--serve``: the serving engine's per-request latency histograms
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
   occupancy, queue-depth/slot/page gauges and serving program HBM
@@ -42,7 +46,7 @@ preemptions, chaos fires — docs/FAULT_TOLERANCE.md), the event log and
 the last-N step records.
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --kernels
 
@@ -137,6 +141,65 @@ def _comms_section(latest, used) -> List[str]:
     if not o_rows and not m_rows:
         out.append("(no comm-overlap or pipeline gauges in this dump — "
                    "run bench.py --multichip with FLAGS_monitor on)")
+        out.append("")
+    return out
+
+
+def _moe_section(latest, used) -> List[str]:
+    """--moe: per-layer router-health table from the ``moe_router_*``
+    gauges MoE layers publish (balance/drop/entropy + per-expert load
+    min/max spread), the dropped-token counter, and any
+    ``moe_fallback_total`` telemetry — the routing-health companion to
+    --comms' comm-overlap view (docs/MOE.md)."""
+    per: Dict[str, dict] = {}
+    loads: Dict[str, Dict[int, float]] = {}
+    for key, row in latest.items():
+        name, labels = key
+        d = dict(labels)
+        if name in ("moe_router_balance_pct", "moe_router_drop_pct",
+                    "moe_router_entropy", "moe_dropped_tokens_total"):
+            used.add(key)
+            per.setdefault(str(d.get("layer", "-")), {})[name] = \
+                row.get("value", 0.0)
+        elif name == "moe_expert_load_share":
+            used.add(key)
+            loads.setdefault(str(d.get("layer", "-")), {})[
+                int(d.get("expert", 0))] = row.get("value", 0.0)
+    def _layer_key(name: str):
+        # "layer10" must sort after "layer2": split the trailing int out
+        import re
+        m = re.match(r"^(.*?)(\d+)$", name)
+        return (m.group(1), int(m.group(2))) if m else (name, -1)
+
+    rows = []
+    for layer in sorted(per | loads, key=_layer_key):
+        d = per.get(layer, {})
+        ld = loads.get(layer, {})
+        spread = (f"{min(ld.values()):.3f}/{max(ld.values()):.3f}"
+                  if ld else "-")
+        rows.append([
+            layer,
+            f"{d.get('moe_router_balance_pct', 0.0):.1f}",
+            f"{d.get('moe_router_drop_pct', 0.0):.1f}",
+            f"{d.get('moe_router_entropy', 0.0):.3f}",
+            spread,
+            f"{d.get('moe_dropped_tokens_total', 0.0):g}"])
+    out = _table("MoE router health (per layer)",
+                 ["layer", "balance%", "drop%", "entropy",
+                  "load min/max", "dropped total"], rows)
+    f_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if name == "moe_fallback_total":
+            used.add(key)
+            f_rows.append([name, _fmt_labels(labels),
+                           f"{latest[key].get('value', 0):g}"])
+    out += _table("MoE expert-parallel fallbacks",
+                  ["counter", "labels", "value"], f_rows)
+    if not rows and not f_rows:
+        out.append("(no moe_router_* gauges in this dump — run an eager "
+                   "MoE forward with FLAGS_monitor on, or "
+                   "publish_moe_telemetry/publish_router_stats)")
         out.append("")
     return out
 
@@ -394,7 +457,8 @@ def render_flight(doc: dict, last: int = 10) -> str:
 
 
 def render(rows: List[dict], top: int = 10, memory: bool = False,
-           serve: bool = False, comms: bool = False) -> str:
+           serve: bool = False, comms: bool = False,
+           moe: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -404,6 +468,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
                             if serve else [])
     # -- comm overlap (--comms) also claims its gauges early -------------
     comms_out: List[str] = (_comms_section(latest, used) if comms else [])
+    # -- MoE router health (--moe) renders next to --comms ---------------
+    comms_out += _moe_section(latest, used) if moe else []
 
     # -- slowest timing histograms ----------------------------------------
     timings = []
@@ -536,6 +602,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     comms = "--comms" in argv
     if comms:
         argv.remove("--comms")
+    moe = "--moe" in argv
+    if moe:
+        argv.remove("--moe")
     kernels = "--kernels" in argv
     if kernels:
         argv.remove("--kernels")
@@ -562,8 +631,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
-    print(render(rows, top=top, memory=memory, serve=serve, comms=comms),
-          end="")
+    print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
+                 moe=moe), end="")
     return 0
 
 
