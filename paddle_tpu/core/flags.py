@@ -295,6 +295,21 @@ define_flag("moe_a2a_chunks", 2,
             "scheduler can hide chunk i+1's exchange behind chunk i's "
             "compute (the PR 9 ppermute double-buffer recipe applied "
             "to ISSUE 10's expert exchange). 1 = no chunking.")
+define_flag("trace", False,
+            "Structured request/step tracing (monitor/trace.py): span "
+            "trees with trace ids through the serving request lifecycle "
+            "and the training step. Off (default) = zero span "
+            "allocations and zero trace registry writes — the same "
+            "zero-overhead contract as FLAGS_monitor, pinned by test.")
+define_flag("trace_sample", 0.01,
+            "Head sampling rate for structured traces (fraction of "
+            "traces retained at random). Tail-based sampling keeps any "
+            "trace containing an expired/shed/failed/watchdog/chaos/"
+            "nonfinite event REGARDLESS of this rate, so anomalies "
+            "always ship a full span tree.")
+define_flag("trace_ring", 64,
+            "Capacity of the retained-trace ring (flight-recorder "
+            "model: newest N traces survive to a dump/export).")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

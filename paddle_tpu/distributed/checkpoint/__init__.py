@@ -163,7 +163,22 @@ def _commit(tmp: str, final: str, leaves: Dict[str, dict],
     """Turn a finished staging dir into a committed checkpoint: write
     extra files + manifest (fsync'd), then atomically rename. A crash at
     ANY point before the rename leaves only the ``.tmp`` dir, which
-    every reader skips."""
+    every reader skips. When a structured step trace is active
+    (FLAGS_trace) the commit appears as a ``checkpoint.commit`` span."""
+    try:
+        from ...monitor import trace as _trace_mod
+        span = _trace_mod.maybe_span("checkpoint.commit", step=step,
+                                     path=final)
+    except Exception:
+        import contextlib
+        span = contextlib.nullcontext()
+    with span:
+        _commit_impl(tmp, final, leaves, extra_files, step)
+
+
+def _commit_impl(tmp: str, final: str, leaves: Dict[str, dict],
+                 extra_files: Optional[Dict[str, str]],
+                 step: Optional[int]) -> None:
     from ...testing import chaos
 
     for name, data in (extra_files or {}).items():

@@ -269,8 +269,18 @@ def _comm_trace(op: str, group: Group, x, cache_key=None):
         span = RecordEvent(f"comm::{op}")
     except Exception:
         span = contextlib.nullcontext()
+    try:
+        # structured-trace child span: attaches under the active
+        # train.step trace (FLAGS_trace + TrainStep's activate()); a
+        # no-op — no allocation — when no trace is current
+        from ..monitor import trace as _trace_mod
+        tspan = _trace_mod.maybe_span(
+            f"collective::{op}", group=group.axis_name,
+            nranks=group.nranks, bytes=nbytes)
+    except Exception:
+        tspan = contextlib.nullcontext()
     t0 = time.perf_counter()
-    with span:
+    with span, tspan:
         yield
     dt = time.perf_counter() - t0
     try:

@@ -704,15 +704,29 @@ class TrainStep:
                       "full TrainStep.__call__ wall time (host prep + "
                       "dispatch)").observe(wall, kind=kind)
 
+    #: _step_span RecordEvent name -> structured-trace span name (the
+    #: step-trace taxonomy of docs/OBSERVABILITY.md: dispatch /
+    #: grad_accum_sync; collective::<op> and checkpoint.commit attach
+    #: through the same maybe_span seam from their own modules)
+    _TRACE_SPAN_NAMES = {"TrainStep.step": "dispatch",
+                         "TrainStep.accum_microstep": "dispatch",
+                         "TrainStep.grad_accum_sync": "grad_accum_sync"}
+
     @contextlib.contextmanager
     def _step_span(self, mon: bool, name: str = "TrainStep.step"):
         """RecordEvent around the dispatch in monitor mode — steps appear
-        on host timelines next to the comm/op lanes."""
+        on host timelines next to the comm/op lanes — and, when a
+        structured step trace is active (FLAGS_trace), the matching
+        child span."""
+        from ..monitor import trace as trace_mod
         if not mon:
-            yield
+            with trace_mod.maybe_span(
+                    self._TRACE_SPAN_NAMES.get(name, name)):
+                yield
             return
         from ..profiler import RecordEvent
-        with RecordEvent(name):
+        with RecordEvent(name), trace_mod.maybe_span(
+                self._TRACE_SPAN_NAMES.get(name, name)):
             yield
 
     def _watchdog(self, loss, prev_params, prev_buffers, key, flat,
@@ -738,6 +752,13 @@ class TrainStep:
             self._consecutive_skips = 0
             return
         self._stats["nonfinite_trips"] += 1
+        from ..monitor import trace as trace_mod
+        cur_trace = trace_mod.current_trace()
+        if cur_trace is not None:
+            # tail-retain the step trace even when the trip is handled
+            # (warn mode / within skip_nonfinite_budget — no raise)
+            cur_trace.mark_anomaly("nonfinite", step=step_index,
+                                   step_kind=step_kind)
         from ..monitor import get_registry
         from ..monitor.numerics import NonFiniteError, first_nonfinite
         # the param scan needs no compilation — run it before (and
@@ -986,6 +1007,28 @@ class TrainStep:
         return Tensor(loss)
 
     def __call__(self, *batch):
+        from ..monitor import trace as trace_mod
+        if not trace_mod.enabled():
+            return self._call_impl(*batch)
+        # one trace per step: dispatch / grad-accum sync spans attach
+        # inside, eager collectives and checkpoint commits through the
+        # activate() context. A non-finite trip tail-retains the trace
+        # whatever FLAGS_trace_sample said.
+        tr = trace_mod.get_tracer().start_trace(
+            "train.step", step=self.step_count + 1)
+        try:
+            with trace_mod.activate(tr):
+                return self._call_impl(*batch)
+        except BaseException as e:
+            from ..monitor.numerics import NonFiniteError
+            tr.mark_anomaly(
+                "nonfinite" if isinstance(e, NonFiniteError)
+                else "failed", error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            trace_mod.get_tracer().finish_trace(tr)
+
+    def _call_impl(self, *batch):
         from ..core.flags import get_flag
         mon = bool(get_flag("monitor"))
         t_wall = time.perf_counter() if mon else 0.0

@@ -34,6 +34,17 @@ Memory/cost/forensics pillars (ISSUE 4, the space-domain counterpart):
    dumped to JSON on unhandled exceptions, NaN-watchdog trips, or
    explicit ``dump()``, with faulthandler wiring for hard crashes.
 
+Where-did-the-time-go pillars (ISSUE 11):
+
+8. **structured tracing** (:mod:`.trace`): per-request / per-step span
+   trees with trace ids, head-rate + tail-based anomaly sampling
+   (``FLAGS_trace`` / ``FLAGS_trace_sample``), a unified Perfetto
+   export merged with the profiler host timeline, histogram exemplars,
+   and trace attachment to flight-recorder dumps;
+9. **SLO burn rate** (:mod:`.slo`): multi-window error-budget burn
+   tracking over serving outcomes with SRE-workbook multiwindow alert
+   arithmetic.
+
 The registry is always importable and writable; the HOT paths only write
 to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
 the write_count guard in tests/test_monitor.py; the flight recorder has
@@ -41,7 +52,7 @@ the same contract via ``FLAGS_flight_recorder`` and its
 ``record_count`` probe).
 """
 
-from . import flight_recorder, memory  # noqa: F401
+from . import flight_recorder, memory, slo, trace  # noqa: F401
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               get_flight_recorder, set_flight_recorder)
 from .memory import (LeakMonitor, MemoryBudgetError,  # noqa: F401
@@ -51,6 +62,9 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       get_registry, load_jsonl, scoped_registry)
 from .numerics import (NaNWatchdog, NonFiniteError, all_finite,  # noqa: F401
                        check_numerics, first_nonfinite, nonfinite_entries)
+from .slo import SLOTracker  # noqa: F401
+from .trace import (Span, Trace, Tracer, export_perfetto,  # noqa: F401
+                    get_tracer, set_tracer, start_trace)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -61,6 +75,8 @@ __all__ = [
     "live_buffer_census", "memory_summary", "preflight_check",
     "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
     "enabled",
+    "Span", "Trace", "Tracer", "get_tracer", "set_tracer",
+    "start_trace", "export_perfetto", "SLOTracker",
 ]
 
 

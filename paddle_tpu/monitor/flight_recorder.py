@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
            "enabled", "safe_record_event", "trip_dump", "load_dump",
-           "RECOVERY_EVENTS"]
+           "RECOVERY_EVENTS", "register_dump_provider"]
 
 _EVENT_CAPACITY = 128
 
@@ -61,6 +61,20 @@ RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
                    "trip", "chaos", "request_failed", "request_expired",
                    "request_cancelled", "request_drained", "request_shed",
                    "decode_watchdog", "overload", "drained")
+
+
+# dump-time attachment hooks: other forensic subsystems (the structured
+# tracer) register a provider so every dump — crash, watchdog trip,
+# explicit — carries their in-flight state under the given key. Called
+# only at dump time (never on the hot path) and best-effort: a raising
+# provider is skipped, the dump must still land.
+_DUMP_PROVIDERS: Dict[str, Any] = {}
+
+
+def register_dump_provider(key: str, fn) -> None:
+    """Attach ``fn()``'s return value under ``doc[key]`` in every
+    future dump. Re-registering a key replaces the provider."""
+    _DUMP_PROVIDERS[key] = fn
 
 
 def _json_safe(v: Any) -> Any:
@@ -77,6 +91,16 @@ def _json_safe(v: Any) -> Any:
     if math.isfinite(f):
         return f
     return repr(f)
+
+
+def _json_safe_tree(v: Any) -> Any:
+    """Recursive :func:`_json_safe` over dicts/lists — provider output
+    is arbitrary nested structure."""
+    if isinstance(v, dict):
+        return {str(k): _json_safe_tree(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe_tree(x) for x in v]
+    return _json_safe(v)
 
 
 class FlightRecorder:
@@ -237,6 +261,14 @@ class FlightRecorder:
                "events": events}
         if extra:
             doc.update({k: _json_safe(v) for k, v in extra.items()})
+        for key, provider in list(_DUMP_PROVIDERS.items()):
+            try:
+                # deep-sanitize: one non-finite float anywhere in a
+                # provider's tree must not sink the whole crash dump
+                # at json.dump(allow_nan=False) time
+                doc.setdefault(key, _json_safe_tree(provider()))
+            except Exception:
+                pass               # the dump itself must still land
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

@@ -141,7 +141,12 @@ class Histogram(_Metric):
             raise ValueError(f"histogram {self.name}: needs >= 1 bucket")
         self.buckets = bs
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation. ``exemplar`` (OpenMetrics exemplars:
+        a trace_id) is attached to the bucket the value lands in — the
+        newest exemplar per bucket wins — so a p99 bucket links to a
+        CONCRETE trace (docs/OBSERVABILITY.md "Structured tracing")."""
         k = _label_key(labels)
         with self._lock:
             st = self._series.get(k)
@@ -149,12 +154,22 @@ class Histogram(_Metric):
                 st = {"counts": [0] * len(self.buckets), "sum": 0.0,
                       "count": 0}
                 self._series[k] = st
+            bucket = None
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     st["counts"][i] += 1
+                    bucket = b
                     break
             st["sum"] += float(value)
             st["count"] += 1
+            if exemplar is not None:
+                # keyed by the bucket's upper bound ("+Inf" past the
+                # last) — the join key readers use against `buckets`
+                st.setdefault("exemplars", {})[
+                    repr(float(bucket)) if bucket is not None
+                    else "+Inf"] = {
+                        "trace_id": str(exemplar),
+                        "value": float(value), "ts": time.time()}
             self._reg._write_count += 1
 
     def _export(self, st) -> dict:
@@ -163,7 +178,20 @@ class Histogram(_Metric):
         for b, c in zip(self.buckets, st["counts"]):
             acc += c
             cum.append([b, acc])
-        return {"count": st["count"], "sum": st["sum"], "buckets": cum}
+        out = {"count": st["count"], "sum": st["sum"], "buckets": cum}
+        if st.get("exemplars"):
+            out["exemplars"] = {le: dict(ex)
+                                for le, ex in st["exemplars"].items()}
+        return out
+
+    def exemplars(self, **labels) -> Dict[str, dict]:
+        """{le: {trace_id, value, ts}} for one label set (empty when no
+        exemplar-carrying observation landed)."""
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            return ({le: dict(ex)
+                     for le, ex in st.get("exemplars", {}).items()}
+                    if st else {})
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -290,6 +318,8 @@ class MetricsRegistry:
                     if info["type"] == "histogram":
                         line.update(count=value["count"], sum=value["sum"],
                                     buckets=value["buckets"])
+                        if value.get("exemplars"):
+                            line["exemplars"] = value["exemplars"]
                     else:
                         line["value"] = value
                     f.write(json.dumps(line) + "\n")

@@ -47,6 +47,7 @@ from ..jit.aot import AOTProgram
 from ..jit.functional import bind, buffer_arrays, param_arrays
 from ..monitor import get_registry
 from ..monitor import flight_recorder as _flight
+from ..monitor import trace as _trace
 from ..testing import chaos
 from .detok import StreamingDetokenizer
 from .kv_cache import PagedCacheView, PagedKVCache, blocks_needed
@@ -103,6 +104,13 @@ class ServingConfig:
     overload_threshold_s: float = 0.0
     overload_alpha: float = 0.3
     overload_exit_frac: float = 0.5
+    #: SLO objectives (monitor/slo.py): fractions in (0,1) arming the
+    #: multi-window error-budget burn trackers — availability over
+    #: request outcomes, deadline over completion slack. 0.0 (default)
+    #: = no tracker, zero extra work per request.
+    slo_availability: float = 0.0
+    slo_deadline: float = 0.0
+    slo_windows: Tuple[float, ...] = (60.0, 300.0, 3600.0)
     #: graceful-drain grace period: how long a drain keeps decoding
     #: in-flight sequences before snapshotting the rest
     drain_budget_s: float = 5.0
@@ -176,6 +184,15 @@ class ServingEngine:
             c.overload_threshold_s, alpha=c.overload_alpha,
             exit_frac=c.overload_exit_frac)
             if c.overload_threshold_s > 0 else None)
+        from ..monitor.slo import SLOTracker
+        self._slo_avail = (SLOTracker(
+            "serve_availability", c.slo_availability,
+            windows=c.slo_windows, clock=clock)
+            if c.slo_availability > 0 else None)
+        self._slo_deadline = (SLOTracker(
+            "serve_deadline", c.slo_deadline,
+            windows=c.slo_windows, clock=clock)
+            if c.slo_deadline > 0 else None)
         self._drain_latch: Optional[DrainLatch] = None
         self._draining = False
         self._drained = False
@@ -358,9 +375,9 @@ class ServingEngine:
             "serving requests by lifecycle event")
 
     def _on_request_event(self, outcome: str, st: RequestState) -> None:
-        """Scheduler terminal-transition hook: metrics + forensics.
-        Only fires on lifecycle events — never per step (the
-        zero-overhead pin)."""
+        """Scheduler terminal-transition hook: metrics + forensics +
+        span-tree closure. Only fires on lifecycle events — never per
+        step (the zero-overhead pin)."""
         self._requests_counter().inc(event=outcome)
         if outcome != "completed":
             self._flight_event(
@@ -369,6 +386,46 @@ class ServingEngine:
                 request_id=st.request.request_id,
                 reason=st.failure, tokens=len(st.generated),
                 preemptions=st.preemptions)
+        if self._slo_avail is not None:
+            # availability: cancelled/drained are client/operator
+            # choices, not served-badly outcomes — they spend no budget
+            if outcome == "completed":
+                self._slo_avail.record(good=1)
+            elif outcome in ("expired", "failed", "shed"):
+                self._slo_avail.record(bad=1)
+            self._slo_avail.publish()
+        if self._slo_deadline is not None and outcome == "expired":
+            # an expiry is a blown deadline whether queued or in-flight
+            # (the completed-on-time case is fed from _accept_token)
+            self._slo_deadline.record(bad=1)
+            self._slo_deadline.publish()
+        self._close_trace(st, outcome)
+
+    def _close_trace(self, st: RequestState, outcome: str) -> None:
+        """Terminal span + retention decision for a traced request (the
+        ``Scheduler._terminate`` seam: every exit path lands here)."""
+        tr = st.trace
+        if tr is None:
+            return
+        now = self.clock()
+        for key in ("queued", "admitted"):
+            sp = st.trace_spans.pop(key, None)
+            if sp is not None:
+                tr.end_span(sp, t=now)
+        tr.event("terminal", t=now, outcome=outcome,
+                 reason=st.failure, tokens=len(st.generated),
+                 preemptions=st.preemptions)
+        if outcome in ("expired", "shed", "failed"):
+            reason = st.failure or ""
+            tr.mark_anomaly(
+                "nonfinite" if "non-finite" in reason
+                else ("chaos" if st.poisoned
+                      else ("failed" if outcome == "failed"
+                            else outcome)),
+                failure=st.failure)
+        tr.root.set_attrs(outcome=outcome)
+        _trace.get_tracer().finish_trace(tr, t=now)
+        st.trace_spans.clear()
 
     @staticmethod
     def _flight_enabled() -> bool:
@@ -409,6 +466,32 @@ class ServingEngine:
             raise
         if chaos.active() and chaos.probe("serve.request.poison"):
             st.poisoned = True
+        if _trace.enabled():
+            # one trace per request; a drain-snapshot trace_id RESUMES
+            # the identity on this (successor) engine. Tail-based
+            # retention needs the buffer regardless of the head coin,
+            # so the trace exists for every request while the flag is
+            # on — the flag OFF path allocates nothing (pinned).
+            resumed = request.trace_id is not None
+            tr = _trace.get_tracer().start_trace(
+                "serve.request", trace_id=request.trace_id,
+                # a resumed identity was handed over deliberately (its
+                # first half may already be retained) — never let a
+                # re-flip of the head coin drop the continuation. All
+                # spans run on the ENGINE clock (t=): injectable in
+                # tests, one time domain per trace.
+                sample=True if resumed else None,
+                t=st.submitted_t,
+                request_id=request.request_id,
+                prompt_len=st.prompt_len,
+                max_new_tokens=request.max_new_tokens,
+                resumed=resumed)
+            st.trace = tr
+            st.trace_spans["queued"] = tr.start_span(
+                "queued", t=st.submitted_t)
+            if st.poisoned:
+                tr.mark_anomaly("chaos",
+                                chaos_site="serve.request.poison")
         self._requests_counter().inc(event="submitted")
         self._publish_gauges()
         return st
@@ -554,13 +637,39 @@ class ServingEngine:
                     pending.sort(key=lambda st: (st.admitted_t,
                                                  st.request.request_id))
                     sched.rollback_admission(pending)
+                    for st in pending:
+                        self._trace_requeue(st, "watchdog_rollback")
                     raise
         if sched.active():
-            sched.ensure_decode_capacity()
+            for st in sched.ensure_decode_capacity():
+                # recompute-preemption: back to the queue with the SAME
+                # trace — the span tree shows the second residency
+                self._trace_requeue(st, "preemption")
             if sched.active():
                 self._run_decode()
         self._publish_gauges()
         return sched.has_work
+
+    def _trace_requeue(self, st: RequestState, reason: str) -> None:
+        """A request lost its slot but lives on (recompute-preemption,
+        watchdog rollback): close the open admitted span and open a new
+        queued one — the trace context SURVIVES, same trace_id."""
+        tr = st.trace
+        if tr is None:
+            return
+        now = self.clock()
+        spn = st.trace_spans.pop("admitted", None)
+        if spn is not None:
+            tr.end_span(spn, t=now, requeued=reason)
+        # a never-prefilled state (watchdog rollback of a later group)
+        # still holds its ORIGINAL open queued span — close it, or the
+        # overwrite below would leak it open forever
+        old_q = st.trace_spans.pop("queued", None)
+        if old_q is not None:
+            tr.end_span(old_q, t=now, requeued=reason)
+        st.trace_spans["queued"] = tr.start_span(
+            "queued", t=now, reason=reason,
+            preemptions=st.preemptions)
 
     def _overload_transition(self, transition: str) -> None:
         reg = get_registry()
@@ -616,6 +725,12 @@ class ServingEngine:
         result = worker.dispatch(job, timeout_s)
         if result is None:
             n_active = len(self.scheduler.active())
+            for _, st in self.scheduler.active():
+                # tail-based sampling: every request aboard a tripped
+                # dispatch is retained with its full span tree
+                if st.trace is not None:
+                    st.trace.mark_anomaly("watchdog",
+                                          watchdog_kind=kind)
             # retry soundness: a donating program hands the live pools
             # to the abandoned dispatch (invalidated on its thread, or
             # mutated in place by a late zombie finish) — only a
@@ -674,6 +789,17 @@ class ServingEngine:
         t0 = self.clock()
         if self._t_first_work is None:
             self._t_first_work = t0
+        for st in group.states:
+            tr = st.trace
+            if tr is not None:
+                # queued ends / admitted opens at the scheduler's
+                # admission stamp, not dispatch time — queueing delay
+                # and prefill wait attribute to the right spans
+                qs = st.trace_spans.pop("queued", None)
+                if qs is not None:
+                    tr.end_span(qs, t=st.admitted_t)
+                st.trace_spans["admitted"] = tr.start_span(
+                    "admitted", t=st.admitted_t, slot=st.slot)
         prog = self._get_prefill(nb, sp)
         temps, tks, tps = self._sampling_arrays(states)
         # a DecodeWatchdogError here propagates to step(), which rolls
@@ -697,6 +823,11 @@ class ServingEngine:
         for i, st in enumerate(states):
             if st is None:
                 continue
+            tr = st.trace
+            if tr is not None:
+                tr.end_span(tr.start_span(
+                    "prefill", parent=st.trace_spans.get("admitted"),
+                    t=t0, bucket=f"b{nb}_s{sp}"), t=now)
             if not ok[i]:
                 self.scheduler.fail(st, "non-finite logits at prefill")
                 continue
@@ -754,6 +885,15 @@ class ServingEngine:
                       "active slots per decode dispatch",
                       buckets=tuple(range(1, B + 1))).observe(n_active)
         for slot, st in list(self.scheduler.active()):
+            tr = st.trace
+            if tr is not None:
+                # decode[i]: this request's share of the batched decode
+                # dispatch that produced token i (i counts generated
+                # tokens; prefill produced token 0)
+                tr.end_span(tr.start_span(
+                    f"decode[{len(st.generated)}]",
+                    parent=st.trace_spans.get("admitted"), t=t0,
+                    batch=n_active), t=now)
             if not ok[slot]:
                 self.scheduler.fail(st, "non-finite logits at decode")
                 continue
@@ -761,6 +901,10 @@ class ServingEngine:
 
     def _accept_token(self, st: RequestState, token: int,
                       now: float) -> None:
+        tr = st.trace
+        # histogram exemplars: a latency bucket links to the concrete
+        # trace that landed in it (None = no-op, the pre-trace path)
+        ex = tr.trace_id if tr is not None else None
         first = st.first_token_t is None
         if first:
             st.first_token_t = now
@@ -768,7 +912,8 @@ class ServingEngine:
             self._observe("ttft", ttft)
             get_registry().histogram(
                 "serve_ttft_seconds",
-                "submit -> first token latency").observe(ttft)
+                "submit -> first token latency").observe(
+                ttft, exemplar=ex)
         st.generated.append(token)
         self._stats["tokens_generated"] += 1
         self._t_last_token = now
@@ -776,6 +921,12 @@ class ServingEngine:
             "serve_tokens_generated_total",
             "tokens sampled across all requests").inc()
         req = st.request
+        det_sp = None
+        if tr is not None and (req.on_token is not None
+                               or req.stop is not None
+                               or self.config.detokenizer is not None):
+            det_sp = tr.start_span("detok", t=self.clock(),
+                                   parent=st.trace_spans.get("admitted"))
         try:
             if chaos.active() and chaos.probe("serve.detok.raise"):
                 raise chaos.ChaosFault("serve.detok.raise")
@@ -791,9 +942,13 @@ class ServingEngine:
             # fault isolation: a raising detokenizer / client callback /
             # malformed stop condition fails ONLY this request — the
             # rest of the batch streams on
+            if det_sp is not None:
+                tr.end_span(det_sp, t=self.clock(), error=repr(e))
             self.scheduler.fail(
                 st, f"detokenizer/callback error: {e!r}")
             return
+        if det_sp is not None:
+            tr.end_span(det_sp, t=self.clock())
         if st.is_done():
             self.scheduler.finish(st)
             e2e = now - st.submitted_t
@@ -805,17 +960,24 @@ class ServingEngine:
                 get_registry().histogram(
                     "serve_tpot_seconds",
                     "mean per-token decode latency per request"
-                ).observe(tpot)
+                ).observe(tpot, exemplar=ex)
             reg = get_registry()
             reg.histogram("serve_e2e_seconds",
-                          "submit -> completion latency").observe(e2e)
+                          "submit -> completion latency").observe(
+                e2e, exemplar=ex)
             if st.deadline_t is not None:
+                slack = st.deadline_t - now
                 reg.histogram(
                     "serve_deadline_slack_seconds",
                     "deadline minus completion time for deadline-"
                     "carrying requests (negative = finished late)",
                     buckets=self.DEADLINE_SLACK_BUCKETS).observe(
-                    st.deadline_t - now)
+                    slack, exemplar=ex)
+                if self._slo_deadline is not None:
+                    self._slo_deadline.record(
+                        good=1 if slack >= 0 else 0,
+                        bad=0 if slack >= 0 else 1)
+                    self._slo_deadline.publish()
 
     def _publish_gauges(self) -> None:
         reg = get_registry()

@@ -292,7 +292,7 @@ def request_spec(st) -> dict:
     resubmitting client re-attaches its own."""
     req = st.request
     s = req.sampling
-    return {
+    spec = {
         "request_id": int(req.request_id),
         "prompt": [int(t) for t in np_tolist(req.prompt)],
         "generated": [int(t) for t in st.generated],
@@ -303,6 +303,15 @@ def request_spec(st) -> dict:
                          else int(req.eos_token_id)),
         "priority": int(getattr(req, "priority", 0)),
     }
+    # trace-context survival: the successor engine resumes the SAME
+    # trace_id (monitor/trace.py), so a drained request's span tree
+    # continues instead of forking a new identity
+    tr = getattr(st, "trace", None)
+    trace_id = (tr.trace_id if tr is not None
+                else getattr(req, "trace_id", None))
+    if trace_id is not None:
+        spec["trace_id"] = str(trace_id)
+    return spec
 
 
 def np_tolist(a):
@@ -384,5 +393,6 @@ def requests_from_snapshot(specs: List[dict]) -> List[object]:
             max_new_tokens=remaining,
             sampling=SamplingParams(**(d.get("sampling") or {})),
             eos_token_id=d.get("eos_token_id"),
-            priority=int(d.get("priority", 0))))
+            priority=int(d.get("priority", 0)),
+            trace_id=d.get("trace_id")))
     return out

@@ -75,6 +75,10 @@ class Request:
     optional custom stop condition ``stop(generated_ids) -> bool``
     evaluated after every accepted token; a raising (malformed) stop
     condition fails ONLY its own request.
+
+    ``trace_id`` resumes an existing trace identity under
+    ``FLAGS_trace`` (drain snapshots carry it so a request's span tree
+    continues on the successor engine); None = the tracer mints one.
     """
 
     prompt: Sequence[int]
@@ -85,6 +89,7 @@ class Request:
     deadline_s: Optional[float] = None
     priority: int = 0
     stop: Optional[Callable] = None
+    trace_id: Optional[str] = None
     request_id: int = field(default_factory=lambda: next(_request_ids))
 
     def __post_init__(self):
@@ -125,6 +130,12 @@ class RequestState:
         self.stop_hit = False
         #: chaos serve.request.poison marked this request
         self.poisoned = False
+        #: structured-tracing context (monitor/trace.py): the engine
+        #: attaches a Trace + open-span handles when FLAGS_trace is on;
+        #: the scheduler itself never touches them (same division of
+        #: labor as the registry — the engine owns observability)
+        self.trace = None
+        self.trace_spans: dict = {}
 
     @property
     def terminal(self) -> bool:

@@ -87,6 +87,8 @@ def publish_compile_counts(registry=None) -> dict:
     reg = registry if registry is not None else get_registry()
     for k, v in counts.items():
         name = k if k.startswith("scan_") else "jax_" + k
+        # emits-metrics: jax_backend_compiles, jax_cache_misses,
+        # emits-metrics: jax_jaxpr_traces, scan_body_traces, scan_calls
         reg.gauge(name, "process-lifetime compile/trace counter "
                         "(utils.compilation)").set(v)
     return counts

@@ -119,6 +119,18 @@ def _serving_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _trace_isolation():
+    """Structured-tracer state (retained ring, live traces, allocation
+    probe) must not leak between tests — the zero-overhead pin reads
+    the probe from a clean 0."""
+    from paddle_tpu.monitor import trace as trace_mod
+    yield
+    if trace_mod._tracer is not None:
+        trace_mod._tracer.reset()
+    trace_mod.reset_trace_stats()
+
+
+@pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle
     paddle.seed(1234)

@@ -37,9 +37,11 @@ _TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
 # ungateable ("%" alone stays rate-like and relative:
 # serve_availability_pct regresses when it shrinks). bubble% is the
 # pipeline-schedule idle share (MULTICHIP record); drop% is the MoE
-# router's dropped-assignment share (BENCH_moe) — same shape, healthy
-# baseline 0.
-_ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%"}
+# router's dropped-assignment share (BENCH_moe); overhead% is the
+# measured tracing tokens/s cost (BENCH_serve) — same shape, healthy
+# baseline ~0.
+_ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%",
+                    "overhead%"}
 # bounded 0-100 QUALITY rates (a drop is the regression), also gated on
 # absolute points: weak-scaling efficiency sits near 100, where the
 # relative 10% band would hide a 9-point efficiency loss; balance is the

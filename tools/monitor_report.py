@@ -28,6 +28,10 @@ stream — prints:
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
   occupancy, queue-depth/slot/page gauges and serving program HBM
   budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
+- with ``--fallbacks``: every counted degradation in ONE table — scan
+  loop-layout, Pallas-kernel XLA, pipeline sequential-GSPMD and MoE
+  auto-path fallbacks with reason labels ("why is this run slow"
+  starts here, not at four separate counters);
 - everything else (counters/gauges) as a flat table.
 
 ``--kernels`` needs no input file: it enumerates the live
@@ -45,9 +49,17 @@ shows trip reason, environment fingerprint, a *recovery timeline*
 preemptions, chaos fires — docs/FAULT_TOLERANCE.md), the event log and
 the last-N step records.
 
+``--trace`` also switches input format: the argument is a structured
+trace dump (``monitor.trace.Tracer.dump`` JSON, or a flight-recorder
+dump carrying a ``traces`` section) and the report renders each span
+tree with per-span duration, EXCLUSIVE time and the critical path
+(``*``), plus an exclusive-time-by-span attribution table
+(docs/OBSERVABILITY.md "Structured tracing").
+
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
+    python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
 
 Exit code: 0 on success (including an empty report), 2 on usage/read
@@ -202,6 +214,40 @@ def _moe_section(latest, used) -> List[str]:
                    "publish_moe_telemetry/publish_router_stats)")
         out.append("")
     return out
+
+
+#: the counted-degradation counters every subsystem publishes when its
+#: primary path cannot serve (docs: PERF_TRANSFORMER/PERF_KERNELS/
+#: PARALLELISM/MOE); one table answers "why is this run slow" instead
+#: of four separate counter greps
+_FALLBACK_COUNTERS = ("scan_fallback_total", "pallas_fallback_total",
+                      "pipeline_fallback_total", "moe_fallback_total")
+
+
+def _fallbacks_section(latest, used) -> List[str]:
+    """--fallbacks: every counted degradation in one table — scan
+    loop-layout fallbacks, Pallas-kernel XLA fallbacks, pipeline
+    sequential-GSPMD degradations and MoE auto-path fallbacks, each
+    with its reason labels."""
+    rows = []
+    total = 0.0
+    for cname in _FALLBACK_COUNTERS:
+        for key in sorted(latest):
+            name, labels = key
+            if name != cname:
+                continue
+            used.add(key)
+            v = float(latest[key].get("value", 0.0))
+            total += v
+            rows.append([name[:-len("_fallback_total")],
+                         _fmt_labels(labels), f"{v:g}"])
+    if not rows:
+        return ["== Fallbacks / degradations ==",
+                "(no *_fallback_total counters in this dump — every "
+                "subsystem served its primary path, or FLAGS_monitor "
+                "was off while they fell back)", ""]
+    return _table(f"Fallbacks / degradations ({total:g} total)",
+                  ["subsystem", "reason", "count"], rows)
 
 
 def _memory_section(latest, used) -> List[str]:
@@ -380,21 +426,30 @@ def _serve_section(latest, used, raw_rows: Optional[List[dict]] = None) \
     return out
 
 
-# recovery-timeline event names (kept in sync with
-# paddle_tpu.monitor.flight_recorder.RECOVERY_EVENTS; inlined so the
-# report renders dumps without importing the framework)
-_RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
-                    "collective_timeout", "nonfinite_skip", "preempted",
-                    "trip", "chaos", "request_failed", "request_expired",
-                    "request_cancelled", "request_drained",
-                    "request_shed", "decode_watchdog", "overload",
-                    "drained")
+# recovery-timeline event names: the canonical tuple lives in
+# paddle_tpu.monitor.flight_recorder.RECOVERY_EVENTS and is imported
+# lazily; this fallback copy ONLY serves a standalone checkout where
+# the framework cannot import (and a sync-pin test asserts it can
+# never drift from the canonical tuple)
+_RECOVERY_EVENTS_FALLBACK = (
+    "checkpoint_commit", "checkpoint_fallback", "collective_timeout",
+    "nonfinite_skip", "preempted", "trip", "chaos", "request_failed",
+    "request_expired", "request_cancelled", "request_drained",
+    "request_shed", "decode_watchdog", "overload", "drained")
+
+
+def _recovery_events() -> tuple:
+    try:
+        from paddle_tpu.monitor.flight_recorder import RECOVERY_EVENTS
+        return RECOVERY_EVENTS
+    except Exception:
+        return _RECOVERY_EVENTS_FALLBACK
 
 
 def _recovery_section(events: List[dict]) -> List[str]:
     """Chronological fault/recovery timeline: what failed, what the
     runtime did about it, relative to the first recovery event."""
-    recov = [r for r in events if r.get("event") in _RECOVERY_EVENTS]
+    recov = [r for r in events if r.get("event") in _recovery_events()]
     if not recov:
         return []
     t0 = next((r["ts"] for r in recov
@@ -456,9 +511,114 @@ def render_flight(doc: dict, last: int = 10) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
+def _span_times(tdoc: dict):
+    """(spans, children, dur, end) helpers for one trace dict; open
+    spans render as zero-duration at their start."""
+    spans = [s for s in (tdoc.get("spans") or [])
+             if s.get("t0") is not None]
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[int, List[dict]] = {}
+    roots: List[dict] = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is None or pid not in by_id:
+            roots.append(s)
+        else:
+            children.setdefault(pid, []).append(s)
+    for v in children.values():
+        v.sort(key=lambda s: (s["t0"], s["span_id"]))
+
+    def end(s):
+        return s["t1"] if s.get("t1") is not None else s["t0"]
+
+    def dur(s):
+        return max(0.0, end(s) - s["t0"])
+
+    return spans, roots, children, dur, end
+
+
+def _render_one_trace(tdoc: dict,
+                      agg: Dict[str, List[float]]) -> List[str]:
+    """One trace's span tree: per-span duration, EXCLUSIVE time
+    (duration minus direct children — where the time actually went) and
+    a ``*`` on the critical path (the root-to-leaf chain through each
+    level's latest-ending child). ``agg`` accumulates exclusive time by
+    normalized span name across traces."""
+    import re
+    spans, roots, children, dur, end = _span_times(tdoc)
+    # critical path: descend into the child that finishes last
+    crit = set()
+    for r in roots:
+        node = r
+        while node is not None:
+            crit.add(node["span_id"])
+            kids = children.get(node["span_id"])
+            node = max(kids, key=end) if kids else None
+    excl = {}
+    for s in spans:
+        kids = children.get(s["span_id"], [])
+        excl[s["span_id"]] = max(
+            0.0, dur(s) - sum(dur(k) for k in kids))
+        agg.setdefault(re.sub(r"\[\d+\]$", "", s["name"]),
+                       [0.0, 0])[0] += excl[s["span_id"]]
+        agg[re.sub(r"\[\d+\]$", "", s["name"])][1] += 1
+    head = (f"-- trace {tdoc.get('trace_id', '?')} "
+            f"({tdoc.get('name', '?')})")
+    if tdoc.get("anomaly"):
+        head += f"  ANOMALY: {tdoc['anomaly']}"
+    if not tdoc.get("finished", True):
+        head += "  [open]"
+    head += ("  [head-sampled]" if tdoc.get("head_sampled")
+             else "  [tail-kept]")
+    lines = [head,
+             f"  {'span':<34} {'ms':>9} {'excl ms':>9}  detail"]
+
+    def walk(s, depth):
+        mark = "*" if s["span_id"] in crit else " "
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted((s.get("attrs") or {}).items())
+            if v is not None)
+        name = ("  " * depth + s["name"])[:34]
+        lines.append(f"{mark} {name:<34} {dur(s) * 1e3:>9.3f} "
+                     f"{excl[s['span_id']] * 1e3:>9.3f}  {detail}")
+        for k in children.get(s["span_id"], []):
+            walk(k, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    lines.append("")
+    return lines
+
+
+def render_traces(traces: List[dict], last: int = 10) -> str:
+    """--trace: span trees with critical-path (*) and exclusive-time
+    attribution, from a ``Tracer.dump`` file (or the ``traces`` section
+    of a flight-recorder dump)."""
+    if not traces:
+        return ("(no traces in this dump — run with FLAGS_trace on; "
+                "healthy traffic is head-sampled at FLAGS_trace_sample, "
+                "anomalies are always kept)\n")
+    anom = sum(1 for t in traces if t.get("anomaly"))
+    lines = [f"== Traces ({len(traces)} retained, {anom} anomalous) ==",
+             ""]
+    agg: Dict[str, List[float]] = {}
+    for tdoc in traces[-last:]:
+        lines += _render_one_trace(tdoc, agg)
+    if len(traces) > last:
+        lines.append(f"  ... {len(traces) - last} more traces "
+                     "(raise --last)")
+        lines.append("")
+    a_rows = [[name, f"{tot * 1e3:,.3f}", str(n)]
+              for name, (tot, n) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][0])]
+    lines += _table("Exclusive time by span (rendered traces)",
+                    ["span", "total excl ms", "count"], a_rows)
+    return "\n".join(lines).rstrip() + "\n"
+
+
 def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
-           moe: bool = False) -> str:
+           moe: bool = False, fallbacks: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -470,6 +630,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     comms_out: List[str] = (_comms_section(latest, used) if comms else [])
     # -- MoE router health (--moe) renders next to --comms ---------------
     comms_out += _moe_section(latest, used) if moe else []
+    # -- unified degradation view (--fallbacks) ---------------------------
+    comms_out += _fallbacks_section(latest, used) if fallbacks else []
 
     # -- slowest timing histograms ----------------------------------------
     timings = []
@@ -593,6 +755,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     flight = "--flight" in argv
     if flight:
         argv.remove("--flight")
+    traces = "--trace" in argv
+    if traces:
+        argv.remove("--trace")
     memory = "--memory" in argv
     if memory:
         argv.remove("--memory")
@@ -605,6 +770,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     moe = "--moe" in argv
     if moe:
         argv.remove("--moe")
+    fallbacks = "--fallbacks" in argv
+    if fallbacks:
+        argv.remove("--fallbacks")
     kernels = "--kernels" in argv
     if kernels:
         argv.remove("--kernels")
@@ -615,7 +783,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if kernels:
         print(render_kernels(), end="")
         return 0
-    if flight:
+    if flight or traces:
         import json
         try:
             with open(argv[0]) as f:
@@ -623,6 +791,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, json.JSONDecodeError) as e:
             print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
             return 2
+        if traces:
+            # a Tracer.dump file, a bare trace list, or a flight dump
+            # whose provider attached a `traces` section
+            tlist = doc if isinstance(doc, list) \
+                else list(doc.get("traces") or [])
+            print(render_traces(tlist, last=last), end="")
+            return 0
         print(render_flight(doc, last=last), end="")
         return 0
     try:
@@ -632,7 +807,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
-                 moe=moe), end="")
+                 moe=moe, fallbacks=fallbacks), end="")
     return 0
 
 
