@@ -992,6 +992,200 @@ def run_moe_mode(quick: bool) -> None:
     write_gated_record("BENCH_moe.json", metrics)
 
 
+def _recsys_dedup_parity(dim: int = 16, tol: float = 1e-6) -> float:
+    """Pin the dedup lookup (fwd + sparse grads) against the naive
+    per-id gather oracle (FLAGS_recsys_dedup off): same rows, same
+    post-push table state. Returns the max abs diff; raises over
+    ``tol`` — a record must never commit on a broken lookup."""
+    import numpy as np
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.recsys import ShardedEmbeddingTable
+
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 64, size=256)          # heavy duplication
+    grads = rng.normal(size=(ids.size, dim)).astype(np.float32)
+    diffs = []
+    states = []
+    for dedup in (True, False):
+        with flag_scope("recsys_dedup", dedup):
+            tab = ShardedEmbeddingTable(64, dim, optimizer="adagrad",
+                                        lr=0.1, seed=11)
+            rows = tab.pull(ids)
+            tab.push(ids, grads)
+            states.append((rows, tab.state_dict()))
+    (r_d, s_d), (r_n, s_n) = states
+    diffs.append(float(np.abs(r_d - r_n).max()))
+    diffs.append(float(np.abs(s_d["data"] - s_n["data"]).max()))
+    diffs.append(float(np.abs(s_d["g2"] - s_n["g2"]).max()))
+    worst = max(diffs)
+    if worst > tol:
+        raise RuntimeError(
+            f"recsys dedup parity broken: max diff {worst:.3e} "
+            f"(fwd/data/g2 = {diffs})")
+    return worst
+
+
+def bench_recsys(quick: bool = False) -> list:
+    """``--recsys``: the giant-embedding DLRM record (BENCH_recsys.json;
+    docs/RECSYS.md) — criteo-synthetic DLRM training through
+    hot-tier-exceeding tiered tables (examples/s, embedding GB/s
+    touched, dedup ratio, per-tier hit rates) plus the online ranking
+    leg (deadline-bounded lookups under the recsys serving engine).
+    The dedup lookup is parity-pinned against the naive per-id gather
+    before any metric is recorded, and the record refuses to commit
+    unless the tier spill/promotion counters are nonzero (the table
+    must actually exceed its hot budget)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import recsys
+    from paddle_tpu.models.dlrm import DLRM, DLRMConfig
+    from paddle_tpu.recsys import (CriteoSynthetic, RecsysEngine,
+                                   RecsysRequest, RecsysServingConfig,
+                                   TieredEmbeddingTable)
+
+    paddle.seed(42)
+    worst = _recsys_dedup_parity()
+    log(f"recsys: dedup-vs-naive parity max diff {worst:.2e} "
+        "(fwd + sparse grads, adagrad state)")
+    if quick:
+        name = "dlrm_tiny"
+        cfg = DLRMConfig(num_dense=4, num_sparse=4, vocab_sizes=4096,
+                         embedding_dim=16, bottom_mlp=(32,),
+                         top_mlp=(32,))
+        B, steps, hot, host = 256, 10, 96, 512
+        serve_requests, K = 12, 32
+    else:
+        name = "dlrm_criteo_small"
+        cfg = DLRMConfig(num_dense=13, num_sparse=8,
+                         vocab_sizes=200_000, embedding_dim=32,
+                         bottom_mlp=(64, 32), top_mlp=(64, 32))
+        B, steps, hot, host = 512, 25, 512, 2048
+        serve_requests, K = 32, 64
+    # tables sized to EXCEED the hot-tier budget (vocab >> hot_rows) and
+    # the host cache (host_rows < touched rows on full runs): training
+    # must spill and promote, or the tiering claim is untested
+    tables = [TieredEmbeddingTable(v, cfg.embedding_dim, hot_rows=hot,
+                                   host_rows=host, admit_after=2,
+                                   lr=0.05, seed=f, name=f"slot{f}")
+              for f, v in enumerate(cfg.vocab_list())]
+    for t in tables:
+        recsys.register_table(t.name, t)
+    model = DLRM(cfg, tables=tables)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    gen = CriteoSynthetic(num_dense=cfg.num_dense,
+                          num_sparse=cfg.num_sparse,
+                          vocab_sizes=cfg.vocab_sizes, alpha=1.05,
+                          batch_size=B, seed=0)
+
+    def train_step(i):
+        dense, ids, labels = gen.batch(i)
+        loss = model.loss(dense, ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    first_loss = train_step(0)
+    train_step(1)                      # warm the eager op caches
+    b0 = sum(t.bytes_pulled + t.bytes_pushed for t in tables)
+    t0 = time.perf_counter()
+    last_loss = None
+    for i in range(2, 2 + steps):
+        last_loss = train_step(i)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    touched = sum(t.bytes_pulled + t.bytes_pushed for t in tables) - b0
+    examples_s = B * steps / dt
+    mbps = touched / dt / 1e6
+    dedup = float(np.mean([t.dedup_ratio for t in tables]))
+    agg = {"hbm_hits": 0, "host_hits": 0, "ssd_reads": 0,
+           "lazy_inits": 0, "promotions": 0, "demotions": 0}
+    for t in tables:
+        for k in agg:
+            agg[k] += t.stats[k]
+        t.publish_tier_metrics()
+    total_hits = (agg["hbm_hits"] + agg["host_hits"] + agg["ssd_reads"]
+                  + agg["lazy_inits"])
+    hbm_pct = 100.0 * agg["hbm_hits"] / max(total_hits, 1)
+    host_pct = 100.0 * agg["host_hits"] / max(total_hits, 1)
+    if not (agg["promotions"] and agg["demotions"]):
+        raise RuntimeError(
+            f"recsys: tier spill/promotion counters are zero ({agg}) — "
+            "the table did not exceed its hot budget; the tiering leg "
+            "measured nothing")
+    log(f"recsys[{name}]: {examples_s:.0f} examples/s "
+        f"({steps} steps x B={B}, loss {first_loss:.3f} -> "
+        f"{last_loss:.3f}), embedding {mbps:.2f} MB/s touched, dedup "
+        f"ratio {dedup:.2f}, tier hits hbm {hbm_pct:.1f}% / host "
+        f"{host_pct:.1f}% (promotions {agg['promotions']}, demotions "
+        f"{agg['demotions']})")
+
+    # online ranking: deadline-bounded lookups through the SAME (now
+    # warm) tables under admission control — the serving half
+    eng = RecsysEngine(model, RecsysServingConfig(max_batch=4))
+    rng = np.random.default_rng(1)
+    for _ in range(serve_requests):
+        eng.submit(RecsysRequest(
+            rng.normal(size=cfg.num_dense).astype(np.float32),
+            gen.sample_ids(rng, K), deadline_s=30.0))
+    eng.run()
+    s = eng.metrics_summary()
+    offered = max(s["requests_submitted"] + s["requests_rejected"], 1)
+    avail = 100.0 * s["requests_completed"] / offered
+    lookup_p99_ms = (s["lookup_p99_s"] or 0.0) * 1e3
+    log(f"recsys[serve]: {s['requests_completed']}/{offered} ranked, "
+        f"{s['candidates_per_sec']:.0f} candidates/s, lookup p99 "
+        f"{lookup_p99_ms:.2f} ms, e2e p99 {(s['e2e_p99_s'] or 0)*1e3:.1f}"
+        " ms")
+    recsys.publish_table_hbm()
+    return [
+        metric_line(f"recsys_{name}_examples_per_sec", examples_s,
+                    "examples/s", vs_baseline=1.0),
+        metric_line(f"recsys_{name}_embedding_mbps", mbps, "MB/s",
+                    vs_baseline=1.0),
+        metric_line(f"recsys_{name}_dedup_ratio", dedup, "ratio",
+                    vs_baseline=1.0),
+        # hit% gates on ABSOLUTE points, higher-is-better (check_bench):
+        # a tier-hit-rate collapse is a perf cliff even when examples/s
+        # survives on a fast host
+        metric_line("recsys_tier_hit_hbm_pct", hbm_pct, "hit%",
+                    vs_baseline=1.0),
+        metric_line("recsys_tier_hit_host_pct", host_pct, "hit%",
+                    vs_baseline=1.0),
+        metric_line("recsys_serve_candidates_per_sec",
+                    s["candidates_per_sec"] or 0.0, "examples/s",
+                    vs_baseline=1.0),
+        metric_line("recsys_serve_lookup_p99_ms", lookup_p99_ms, "ms",
+                    vs_baseline=1.0),
+        metric_line("recsys_serve_availability_pct", avail, "%",
+                    vs_baseline=1.0),
+    ]
+
+
+def run_recsys_mode(quick: bool) -> None:
+    """--recsys: emit ONLY the recsys metric lines, dump the registry
+    (tier hit/occupancy gauges for monitor_report --recsys) and
+    write/self-gate BENCH_recsys.json (full runs) — the --moe/--serve
+    contract."""
+    import os
+    metrics = bench_recsys(quick=quick)
+    for m in metrics:
+        print(json.dumps(m), flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from paddle_tpu.monitor import get_registry
+        mpath = os.path.join(here, "BENCH_monitor.jsonl")
+        get_registry().dump_jsonl(mpath, extra={"source": "bench_recsys"})
+        log(f"monitor: registry dumped to {mpath} "
+            "(render: python tools/monitor_report.py --recsys)")
+    except Exception as e:
+        log(f"monitor dump skipped: {e!r}")
+    if quick:
+        log("recsys: --quick run, BENCH_recsys.json not written")
+        return
+    write_gated_record("BENCH_recsys.json", metrics)
+
+
 def bench_multichip(quick: bool = False) -> list:
     """``--multichip``: the DP×TP×PP record on an 8-device VIRTUAL mesh
     (docs/PARALLELISM.md methodology) — weak-scaling efficiency across
@@ -1467,6 +1661,11 @@ def main() -> None:
     if "--moe" in sys.argv:
         # MoE dispatch + gpt-8E record (BENCH_moe)
         run_moe_mode(quick=not full)
+        return
+    if "--recsys" in sys.argv:
+        # giant-embedding DLRM training + online ranking record
+        # (BENCH_recsys)
+        run_recsys_mode(quick=not full)
         return
     metrics = []
 

@@ -295,6 +295,27 @@ define_flag("moe_a2a_chunks", 2,
             "scheduler can hide chunk i+1's exchange behind chunk i's "
             "compute (the PR 9 ppermute double-buffer recipe applied "
             "to ISSUE 10's expert exchange). 1 = no chunking.")
+define_flag("recsys_dedup", True,
+            "Unique/dedup embedding lookups in paddle_tpu.recsys "
+            "(docs/RECSYS.md): sort-unique the batch ids, gather each "
+            "distinct row ONCE, inverse-permute back — duplicate ids in "
+            "a batch (the criteo hot-id regime) cost one row fetch, and "
+            "sparse gradients accumulate over the unique set before the "
+            "optimizer row update (the reference SparseTable push "
+            "semantics). Off = the naive per-id gather/scatter — the "
+            "parity oracle and kill switch (same math, O(batch) instead "
+            "of O(unique) row traffic).")
+define_flag("recsys_sharded_lookup", True,
+            "Run ShardedEmbeddingTable lookups/updates through the "
+            "EXPLICIT mesh program (shard_map manual over the 'ps' "
+            "axis: each shard gathers the unique rows it owns, one "
+            "psum assembles the batch — the PR 9/10 manual-collectives "
+            "recipe) when a ps>1 mesh is active and the backend can "
+            "compile it. Off (or on incapable backends — XLA:CPU with "
+            "another nontrivial mesh axis) = the GSPMD auto path: the "
+            "row-sharded table keeps its P('ps', ...) spec and XLA "
+            "inserts the collectives (counted recsys_fallback_total "
+            "telemetry, moe/nn.scan fallback convention).")
 define_flag("trace", False,
             "Structured request/step tracing (monitor/trace.py): span "
             "trees with trace ids through the serving request lifecycle "

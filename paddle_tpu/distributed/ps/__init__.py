@@ -113,6 +113,26 @@ class SparseTable:
             self.data[uniq] -= self.lr * acc
         self.push_count += 1
 
+    # -- raw row access (tier promotion/demotion; no optimizer step) -------
+    def read_rows(self, ids):
+        """(vecs [n, dim], g2 [n]) WITHOUT counting a pull — the tier
+        manager's raw read when promoting rows into a faster tier."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = self._local(ids)
+        g2 = (self._g2[local].copy() if self.optimizer == "adagrad"
+              else np.zeros(len(local), np.float32))
+        return self.data[local].copy(), g2
+
+    def write_rows(self, ids, vecs, g2=None) -> None:
+        """Overwrite rows (and optimizer state) verbatim — the tier
+        manager's demotion write-back. NOT a push: no gradient math."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = self._local(ids)
+        self.data[local] = np.asarray(vecs, np.float32).reshape(
+            len(local), self.dim)
+        if self.optimizer == "adagrad" and g2 is not None:
+            self._g2[local] = np.asarray(g2, np.float32).reshape(-1)
+
     # -- checkpoint --------------------------------------------------------
     def state_dict(self) -> Dict[str, np.ndarray]:
         out = {"data": self.data}

@@ -70,6 +70,11 @@ class SSDSparseTable:
         self.pull_count = 0
         self.push_count = 0
         self.evict_count = 0
+        # per-source row reads: the host-cache/SSD split a tier manager
+        # (paddle_tpu.recsys.tiering) surfaces as host vs ssd hit rates
+        self.cache_hit_count = 0
+        self.log_read_count = 0
+        self.lazy_init_count = 0
 
     # -- row lifecycle -----------------------------------------------------
     def _init_row(self, rid: int) -> np.ndarray:
@@ -106,13 +111,16 @@ class SSDSparseTable:
         cache entry and refreshes recency."""
         hit = self._cache.get(rid)
         if hit is not None:
+            self.cache_hit_count += 1
             self._cache.move_to_end(rid)
             return hit
         off = self._index.get(rid)
         if off is not None:
+            self.log_read_count += 1
             stored_rid, vec, g2 = self._read_row(off)
             assert stored_rid == rid, "corrupt SSD table index"
         else:
+            self.lazy_init_count += 1
             vec, g2 = self._init_row(rid), 0.0
         self._cache[rid] = (vec, g2)
         self._evict_to_cap()
@@ -153,6 +161,32 @@ class SSDSparseTable:
                 vec = vec - self.lr * g
             self._cache[rid] = (vec.astype(np.float32), g2)
         self.push_count += 1
+
+    # -- raw row access (tier promotion/demotion; no optimizer step) -------
+    def read_rows(self, ids):
+        """(vecs [n, dim], g2 [n]) without cache promotion or pull
+        accounting — the tier manager's raw read (``_load_cold`` walk,
+        so a promotion scan never thrashes the hot cache)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = self._local(ids)
+        vecs = np.empty((len(local), self.dim), np.float32)
+        g2 = np.empty((len(local),), np.float32)
+        for i, rid in enumerate(local):
+            v, g = self._load_cold(int(rid))
+            vecs[i], g2[i] = v, g
+        return vecs, g2
+
+    def write_rows(self, ids, vecs, g2=None) -> None:
+        """Overwrite rows (and adagrad state) verbatim — the tier
+        manager's demotion write-back. NOT a push: no gradient math."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = self._local(ids)
+        vecs = np.asarray(vecs, np.float32).reshape(len(local), self.dim)
+        g2 = (np.zeros(len(local), np.float32) if g2 is None
+              else np.asarray(g2, np.float32).reshape(-1))
+        for i, rid in enumerate(local):
+            self._cache[int(rid)] = (vecs[i].copy(), float(g2[i]))
+        self._evict_to_cap()
 
     # -- maintenance -------------------------------------------------------
     @property

@@ -14,8 +14,10 @@ from .bert import (BertConfig, BertModel, BertForMaskedLM, bert_tiny,
                    bert_base)
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ernie_tiny, ernie_base, ernie_3_1p5b)
+from .dlrm import DLRM, DLRMConfig, TableEmbedding, dlrm_tiny
 
 __all__ = [
+    "DLRM", "DLRMConfig", "TableEmbedding", "dlrm_tiny",
     "GPTConfig", "GPTModel", "GPTForPretraining", "GPTForPretrainingPipe",
     "GPTPretrainingCriterion",
     "gpt_tiny", "gpt2_small", "gpt2_medium",

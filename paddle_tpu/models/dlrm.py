@@ -1,0 +1,197 @@
+"""DLRM: the deep-learning recommendation model (Naumov et al., 2019).
+
+reference parity: the reference serves this workload shape through its
+fleet PS mode (CTR models over DownpourSparseTable embeddings); here
+the model is in-tree as the flagship consumer of the
+``paddle_tpu.recsys`` giant-embedding subsystem (docs/RECSYS.md):
+
+- dense features → bottom MLP → one ``embedding_dim`` vector;
+- each sparse slot's id → an embedding TABLE lookup — the tables are
+  SparseTable-protocol objects (host :class:`SparseTable`,
+  :class:`SSDSparseTable`, :class:`~paddle_tpu.recsys.
+  TieredEmbeddingTable`, :class:`~paddle_tpu.recsys.
+  ShardedEmbeddingTable`), NOT dense Parameters: dense optimizers skip
+  them, gradients stream into the tables through the backward tape
+  (the PS push path), exactly like ``DistributedEmbedding``;
+- pairwise-dot feature interaction over the stacked vectors (upper
+  triangle), concatenated with the bottom output;
+- top MLP → one click logit.
+
+The embedding phase is timed per forward (``last_timings``) so the
+serving engine can attribute lookup latency separately from MLP
+compute.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from ..core.tensor import (Tensor, TapeNode, _wrap_outputs,
+                           is_grad_enabled)
+from ..nn.layer import Layer, LayerList, Sequential
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["DLRMConfig", "TableEmbedding", "DLRM", "dlrm_tiny"]
+
+
+class TableEmbedding(Layer):
+    """Embedding over a SparseTable-protocol table with a device-array
+    fast path: forward uses ``table.lookup`` (jnp rows, no host round
+    trip for HBM-resident tables) when present, else ``table.pull``;
+    backward pushes the row gradients into the table (the PS push).
+    Eager-only, like ``DistributedEmbedding`` — the table lives outside
+    the compiled program."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = table
+        self.embedding_dim = int(table.dim)
+
+    def forward(self, ids) -> Tensor:
+        from ..core.tensor import _is_tracer
+        raw = ids._data if isinstance(ids, Tensor) else ids
+        if _is_tracer(raw):
+            raise RuntimeError(
+                "TableEmbedding pulls from a PS table and is eager-only; "
+                "keep it outside jit/TrainStep (feed its output as a "
+                "batch input)")
+        ids_np = np.asarray(raw)
+        lookup = getattr(self.table, "lookup", None)
+        if lookup is not None:
+            rows = lookup(ids_np.reshape(-1))
+        else:
+            import jax.numpy as jnp
+            rows = jnp.asarray(self.table.pull(ids_np.reshape(-1)))
+        out = rows.reshape(ids_np.shape + (self.embedding_dim,))
+        node = None
+        if is_grad_enabled():
+            push = self.table.push
+
+            def vjp_fn(g, ids_np=ids_np):
+                push(ids_np.reshape(-1), np.asarray(g))
+                return ()
+
+            node = TapeNode(vjp_fn, [],
+                            [jax.ShapeDtypeStruct(out.shape, out.dtype)],
+                            name="recsys_embedding")
+        return _wrap_outputs(out, node=node)
+
+
+@dataclass
+class DLRMConfig:
+    num_dense: int = 4
+    num_sparse: int = 8
+    #: one vocab for every slot, or a per-slot list
+    vocab_sizes: Union[int, Sequence[int]] = 10_000
+    embedding_dim: int = 16
+    bottom_mlp: Tuple[int, ...] = (32,)
+    top_mlp: Tuple[int, ...] = (32,)
+
+    def vocab_list(self) -> List[int]:
+        v = self.vocab_sizes
+        if isinstance(v, (int, np.integer)):
+            return [int(v)] * self.num_sparse
+        if len(v) != self.num_sparse:
+            raise ValueError("vocab_sizes must match num_sparse")
+        return [int(x) for x in v]
+
+
+def _mlp(sizes: Sequence[int], final_act: bool) -> Sequential:
+    layers: list = []
+    for i in range(len(sizes) - 1):
+        layers.append(nn.Linear(sizes[i], sizes[i + 1]))
+        if final_act or i < len(sizes) - 2:
+            layers.append(nn.ReLU())
+    return Sequential(*layers)
+
+
+class DLRM(Layer):
+    """``forward(dense [B, num_dense], ids [B, num_sparse]) -> logits
+    [B]``. ``tables`` injects the embedding stores (one per sparse
+    slot, or one shared); default = per-slot host ``SparseTable``."""
+
+    def __init__(self, config: DLRMConfig, tables: Optional[list] = None,
+                 table_optimizer: str = "adagrad", table_lr: float = 0.05,
+                 seed: int = 0):
+        super().__init__()
+        self.cfg = config
+        vocabs = config.vocab_list()
+        D = config.embedding_dim
+        if tables is None:
+            from ..distributed.ps import SparseTable
+            tables = [SparseTable(v, D, optimizer=table_optimizer,
+                                  lr=table_lr, seed=seed + f)
+                      for f, v in enumerate(vocabs)]
+        elif len(tables) == 1 and config.num_sparse > 1:
+            tables = list(tables) * config.num_sparse   # one shared table
+        if len(tables) != config.num_sparse:
+            raise ValueError(
+                f"need {config.num_sparse} tables (or 1 shared), got "
+                f"{len(tables)}")
+        for t in tables:
+            if int(t.dim) != D:
+                raise ValueError("every table's dim must equal "
+                                 f"embedding_dim={D}")
+        self.embeddings = LayerList([TableEmbedding(t) for t in tables])
+        self.bottom = _mlp((config.num_dense,) + tuple(config.bottom_mlp)
+                           + (D,), final_act=True)
+        F_feat = config.num_sparse + 1
+        self._triu = np.triu_indices(F_feat, k=1)
+        n_pairs = len(self._triu[0])
+        self.top = _mlp((D + n_pairs,) + tuple(config.top_mlp) + (1,),
+                        final_act=False)
+        #: wall-clock split of the last eager forward — the serving
+        #: engine's lookup-vs-rank latency attribution
+        self.last_timings = {"lookup_s": 0.0, "mlp_s": 0.0}
+
+    @property
+    def tables(self) -> list:
+        return [e.table for e in self.embeddings]
+
+    def forward(self, dense, ids) -> Tensor:
+        import paddle_tpu as paddle
+        t0 = time.perf_counter()
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+        if ids_np.ndim != 2 or ids_np.shape[1] != self.cfg.num_sparse:
+            raise ValueError(
+                f"ids must be [B, {self.cfg.num_sparse}], got "
+                f"{ids_np.shape}")
+        embs = [emb(ids_np[:, f])
+                for f, emb in enumerate(self.embeddings)]
+        t1 = time.perf_counter()
+        x = self.bottom(dense if isinstance(dense, Tensor)
+                        else paddle.to_tensor(np.asarray(dense,
+                                                         np.float32)))
+        z = paddle.stack([x] + embs, axis=1)         # [B, F+1, D]
+        inter = paddle.matmul(z, paddle.transpose(z, [0, 2, 1]))
+        flat = paddle.reshape(inter, [inter.shape[0], -1])
+        F_feat = self.cfg.num_sparse + 1
+        pair_idx = self._triu[0] * F_feat + self._triu[1]
+        pairs = paddle.index_select(
+            flat, paddle.to_tensor(pair_idx.astype(np.int64)), axis=1)
+        top_in = paddle.concat([x, pairs], axis=-1)
+        logits = paddle.reshape(self.top(top_in), [-1])
+        t2 = time.perf_counter()
+        self.last_timings = {"lookup_s": t1 - t0, "mlp_s": t2 - t1}
+        return logits
+
+    def loss(self, dense, ids, labels) -> Tensor:
+        import paddle_tpu as paddle
+        logits = self(dense, ids)
+        lab = labels if isinstance(labels, Tensor) else paddle.to_tensor(
+            np.asarray(labels, np.float32))
+        return F.binary_cross_entropy_with_logits(logits, lab)
+
+
+def dlrm_tiny(**over) -> DLRMConfig:
+    """Test-scale config (the gpt_tiny convention)."""
+    kw = dict(num_dense=4, num_sparse=4, vocab_sizes=512,
+              embedding_dim=8, bottom_mlp=(16,), top_mlp=(16,))
+    kw.update(over)
+    return DLRMConfig(**kw)

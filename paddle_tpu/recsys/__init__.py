@@ -1,0 +1,149 @@
+"""Giant-embedding recommender subsystem (docs/RECSYS.md).
+
+reference parity: the reference's single largest subsystem outside the
+op library is the 22k-LoC parameter-server stack
+(paddle/fluid/distributed/: brpc servers, SparseTable shards,
+ssd_sparse_table.h) driving DLRM-shaped recsys traffic — embedding
+tables of 10^9 rows updated sparsely, pulled at serving deadlines.
+`distributed/ps/` rebuilt the host tier (SparseTable / SSDSparseTable /
+DistributedEmbedding); this package makes recsys a first-class training
+AND serving axis on top of it (ISSUE 12):
+
+- :class:`~.sharded_table.ShardedEmbeddingTable` — embedding rows laid
+  out ACROSS the mesh (row-sharded over the ``ps`` axis via shard_map
+  manual collectives, the PR 9/10 recipe, with a GSPMD auto fallback
+  counted through :func:`note_recsys_fallback`), unique/dedup lookups
+  (sort-unique → one gather → inverse-permute) and sparse-grad
+  optimizer state colocated with the rows it updates;
+- :class:`~.tiering.TieredEmbeddingTable` — an HBM-resident hot tier
+  fronting the host/SSD tables (admission by access frequency,
+  eviction by LRU), so a table exceeds single-chip HBM and then host
+  RAM while the hot set serves at device speed (Monolith-style hot-ID
+  tiering over the ssd_table heritage);
+- :class:`~.data.CriteoSynthetic` — seeded power-law workload generator
+  (the criteo shape: dense floats + one id per sparse slot);
+- :class:`~.serving.RecsysEngine` — online lookup + ranking riding the
+  PR 6/8 serving discipline: bounded-queue admission, deadlines,
+  overload shedding, lookup-latency histograms;
+- :mod:`~.checkpoint` — sharded-table save/restore through the PR 5
+  atomic checkpoint manifest (torn commits fall back, chaos-drilled).
+
+The model half lives in :mod:`paddle_tpu.models.dlrm` (dense bottom
+MLP, N sparse features through these tables, pairwise interaction,
+top MLP — Naumov et al.).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, Optional
+
+__all__ = ["RECSYS_STATS", "reset_recsys_stats", "note_recsys_fallback",
+           "register_table", "tables", "publish_table_hbm", "reset",
+           "ShardedEmbeddingTable", "TieredEmbeddingTable",
+           "CriteoSynthetic", "RecsysEngine", "RecsysRequest",
+           "RecsysServingConfig", "save_tables", "load_tables"]
+
+#: observability (the nn/scan SCAN_STATS convention): explicit mesh
+#: lookups/updates, auto-path dispatches, and fallbacks (a ps>1 mesh is
+#: present but the explicit shard_map program could not run).
+RECSYS_STATS = {"manual_lookups": 0, "auto_lookups": 0,
+                "manual_updates": 0, "auto_updates": 0, "fallbacks": 0}
+
+_FALLBACK_WARNED: set = set()
+
+
+def reset_recsys_stats() -> None:
+    for k in RECSYS_STATS:
+        RECSYS_STATS[k] = 0
+    _FALLBACK_WARNED.clear()
+
+
+def note_recsys_fallback(reason: str, detail: str = "") -> None:
+    """A ps>1 mesh is active but the explicit sharded-lookup program
+    degraded to the GSPMD auto path — same math, XLA places the
+    collectives. One-time warning per cause + counted (monitor mode
+    adds a ``recsys_fallback_total`` registry counter)."""
+    RECSYS_STATS["fallbacks"] += 1
+    key = (reason, detail)
+    if key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(key)
+        warnings.warn(
+            f"recsys sharded lookup degraded to the GSPMD auto path "
+            f"(reason: {reason}{'; ' + detail if detail else ''}); the "
+            "math is unchanged but the explicit gather+psum program "
+            "does not run. On XLA:CPU this is expected for meshes with "
+            "other nontrivial axes (manual-subgroup collectives); on "
+            "TPU check FLAGS_recsys_sharded_lookup and the mesh axes.",
+            RuntimeWarning, stacklevel=3)
+    from ..monitor import enabled as _mon_enabled
+    if _mon_enabled():
+        from ..monitor import get_registry
+        get_registry().counter(
+            "recsys_fallback_total",
+            "ps meshes that degraded to the GSPMD auto path, by cause",
+        ).inc(reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# Table registry: monitor_report --recsys and the HBM attribution walk
+# name every live table through here (reset() clears it between tests).
+# ---------------------------------------------------------------------------
+
+_TABLES: "Dict[str, object]" = {}
+
+
+def register_table(name: str, table) -> None:
+    _TABLES[name] = table
+
+
+def tables() -> Dict[str, object]:
+    return dict(_TABLES)
+
+
+def publish_table_hbm(registry=None) -> Dict[str, int]:
+    """Per-table HBM attribution (the PR 4 census discipline applied to
+    embedding tables): every registered table reports the DEVICE bytes
+    its hot/sharded arrays pin, cross-checked against ``jax.
+    live_arrays()`` by buffer identity so a dropped-but-registered
+    table attributes 0, not its configured capacity. Publishes
+    ``recsys_table_hbm_bytes{table=...}`` gauges; returns {name: bytes}."""
+    import jax
+    live = {id(a) for a in jax.live_arrays()}
+    out: Dict[str, int] = {}
+    for name, t in _TABLES.items():
+        arrs = getattr(t, "device_arrays", lambda: [])()
+        out[name] = sum(int(a.nbytes) for a in arrs if id(a) in live)
+    if out:
+        from ..monitor import get_registry
+        g = (registry or get_registry()).gauge(
+            "recsys_table_hbm_bytes",
+            "device bytes pinned by a registered embedding table's "
+            "hot/sharded arrays (live-buffer identity census)")
+        for name, b in out.items():
+            g.set(b, table=name)
+    return out
+
+
+def reset() -> None:
+    """Test isolation: clear table registry + stats, close tables that
+    own temp SSD files, and drop any live recsys serving engines."""
+    for t in _TABLES.values():
+        close = getattr(t, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+    _TABLES.clear()
+    reset_recsys_stats()
+    from .serving import reset_engines
+    reset_engines()
+
+
+from .sharded_table import ShardedEmbeddingTable  # noqa: E402
+from .tiering import TieredEmbeddingTable  # noqa: E402
+from .data import CriteoSynthetic  # noqa: E402
+from .serving import (RecsysEngine, RecsysRequest,  # noqa: E402
+                      RecsysServingConfig)
+from .checkpoint import load_tables, save_tables  # noqa: E402

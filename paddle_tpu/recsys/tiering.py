@@ -1,0 +1,431 @@
+"""Hierarchical embedding tiering: HBM hot tier ← host ← SSD.
+
+reference parity: ssd_sparse_table.h's memory-cache-over-rocksdb, and
+Monolith's collisionless hot-ID tables — the observation both encode is
+that recsys id traffic is power-law: a tiny hot set takes almost every
+hit, so the hot rows must live at device speed while the long tail
+spills down the hierarchy.
+
+Design: :class:`TieredEmbeddingTable` owns an HBM-resident hot tier (a
+device array of ``hot_rows`` slots + a host-side id→slot map) fronting
+a *backing* table — by default an
+:class:`~paddle_tpu.distributed.ps.SSDSparseTable`, whose own LRU cache
+is the HOST tier and whose log-structured file is the SSD tier, giving
+the full HBM ← host ← SSD ladder; any SparseTable-protocol object
+(e.g. a plain host :class:`SparseTable`) works as a two-tier stack.
+
+Row residency is EXCLUSIVE (Monolith-style): a row lives in exactly one
+tier; promotion moves it up (raw read incl. optimizer state via
+``read_rows``), demotion writes it back verbatim (``write_rows`` — no
+gradient math on the move). Admission is by access frequency (a row is
+promoted after ``admit_after`` pulls), eviction is LRU over the hot
+slots. Pulls and pushes keep SparseTable's semantics: duplicate-id
+gradients accumulate over the unique set before the row update, hot
+rows update ON DEVICE with the same adagrad/sgd formulas.
+
+Per-tier hit/miss/promotion counters stream through ``monitor/``
+(:meth:`publish_tier_metrics`; rendered by
+``tools/monitor_report.py --recsys``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TieredEmbeddingTable"]
+
+
+class TieredEmbeddingTable:
+    """SparseTable-protocol table whose hot rows live in device memory.
+
+    ``hot_rows`` caps HBM residency; ``admit_after`` is the access
+    frequency that earns a row promotion (1 = admit on first touch).
+    """
+
+    def __init__(self, num_rows: int, dim: int, hot_rows: int = 4096,
+                 backing=None, host_rows: Optional[int] = None,
+                 admit_after: int = 2, optimizer: str = "adagrad",
+                 lr: float = 0.05, seed: int = 0, name: str = "table"):
+        if optimizer not in ("adagrad", "sgd"):
+            raise ValueError(f"unknown PS optimizer {optimizer!r}")
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.hot_rows = max(1, int(hot_rows))
+        self.admit_after = max(1, int(admit_after))
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.name = name
+        self._own_backing = backing is None
+        if backing is None:
+            from ..distributed.ps import SSDSparseTable
+            backing = SSDSparseTable(
+                num_rows, dim,
+                cache_rows=host_rows if host_rows is not None
+                else max(4 * self.hot_rows, 1024),
+                optimizer=optimizer, lr=lr, seed=seed)
+        if getattr(backing, "optimizer", optimizer) != optimizer or \
+                getattr(backing, "lr", lr) != lr:
+            raise ValueError(
+                "tier optimizer/lr must match the backing table's (a "
+                "row's update rule cannot depend on which tier it is in)")
+        self.backing = backing
+        self._hot = jnp.zeros((self.hot_rows, self.dim), jnp.float32)
+        self._hot_g2 = jnp.zeros((self.hot_rows,), jnp.float32)
+        self._slot_of: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, int]" = OrderedDict()   # rid -> slot
+        self._free: List[int] = list(range(self.hot_rows - 1, -1, -1))
+        self._freq: Dict[int, int] = {}
+        #: hot rows updated since promotion — only these need the
+        #: demotion write-back (a clean row's backing copy is current)
+        self._dirty: set = set()
+        self.pull_count = 0
+        self.push_count = 0
+        self.stats = {"hbm_hits": 0, "host_hits": 0, "ssd_reads": 0,
+                      "lazy_inits": 0, "promotions": 0, "demotions": 0,
+                      "evictions": 0}
+        self._published = dict(self.stats)
+        self.bytes_pulled = 0
+        self.bytes_pushed = 0
+        self.ids_seen = 0
+        self.rows_fetched = 0
+
+    # -- hot-tier bookkeeping ----------------------------------------------
+    def _touch(self, rid: int) -> None:
+        self._lru.move_to_end(rid)
+
+    def _backing_read_stats(self):
+        b = self.backing
+        return (getattr(b, "cache_hit_count", None),
+                getattr(b, "log_read_count", 0),
+                getattr(b, "lazy_init_count", 0))
+
+    def _count_backing(self, before, n_rows: int) -> None:
+        """Attribute ``n_rows`` backing fetches to host/ssd tiers from
+        the backing table's read-source counters (SSDSparseTable); a
+        plain host table attributes everything to the host tier."""
+        after = self._backing_read_stats()
+        if before[0] is None or after[0] is None:
+            self.stats["host_hits"] += n_rows
+            return
+        self.stats["host_hits"] += after[0] - before[0]
+        self.stats["ssd_reads"] += after[1] - before[1]
+        self.stats["lazy_inits"] += after[2] - before[2]
+
+    def _evict_one(self) -> int:
+        """Free the LRU hot slot. Only a DIRTY row (updated while hot)
+        is demoted — written back verbatim (value + optimizer state, no
+        gradient math); a clean row's backing copy is still current, so
+        its eviction costs no I/O. Hence evictions >= demotions."""
+        rid, slot = self._lru.popitem(last=False)
+        del self._slot_of[rid]
+        self.stats["evictions"] += 1
+        if rid in self._dirty:
+            self._dirty.discard(rid)
+            vec = np.asarray(self._hot[slot]).reshape(1, self.dim)
+            g2 = np.asarray(self._hot_g2[slot]).reshape(1)
+            self.backing.write_rows([rid], vec, g2)
+            self.stats["demotions"] += 1
+        return slot
+
+    def _attribute_raw_reads(self, rids: List[int]) -> None:
+        """Per-tier attribution of promotion reads (read_rows bypasses
+        the backing's own counters): probe residency directly for an
+        SSD backing; anything else attributes to the host tier."""
+        b = self.backing
+        cache = getattr(b, "_cache", None)
+        index = getattr(b, "_index", None)
+        if cache is None or getattr(b, "num_shards", 1) != 1:
+            self.stats["host_hits"] += len(rids)
+            return
+        for rid in rids:
+            if rid in cache:
+                self.stats["host_hits"] += 1
+            elif index is not None and rid in index:
+                self.stats["ssd_reads"] += 1
+            else:
+                self.stats["lazy_inits"] += 1
+
+    def _insert_hot(self, rids: List[int], vecs: np.ndarray,
+                    g2: np.ndarray) -> None:
+        """Install already-read rows into the hot tier, evicting LRU
+        rows as needed. A batch larger than the free-slot count commits
+        row by row: an eviction mid-batch reads the hot array, so every
+        earlier insertion of THIS batch must already be written (a
+        batched write would demote stale slot contents)."""
+        if not rids:
+            return
+        if len(rids) <= len(self._free):
+            slots = []
+            for rid in rids:
+                slot = self._free.pop()
+                self._slot_of[rid] = slot
+                self._lru[rid] = slot
+                slots.append(slot)
+            idx = jnp.asarray(np.asarray(slots, np.int32))
+            self._hot = self._hot.at[idx].set(jnp.asarray(vecs))
+            self._hot_g2 = self._hot_g2.at[idx].set(jnp.asarray(g2))
+        else:
+            for i, rid in enumerate(rids):
+                slot = (self._free.pop() if self._free
+                        else self._evict_one())
+                self._slot_of[rid] = slot
+                self._lru[rid] = slot
+                self._hot = self._hot.at[slot].set(jnp.asarray(vecs[i]))
+                self._hot_g2 = self._hot_g2.at[slot].set(float(g2[i]))
+        self.stats["promotions"] += len(rids)
+
+    def _age_freq(self) -> None:
+        """Bound the frequency map: when it outgrows the hot set by a
+        wide margin, drop the single-touch tail (power-law traffic
+        keeps genuinely hot ids above 1)."""
+        if len(self._freq) > max(65536, 16 * self.hot_rows):
+            self._freq = {r: c for r, c in self._freq.items() if c > 1}
+
+    # -- SparseTable protocol ----------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        self.pull_count += 1
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        self.ids_seen += ids_np.size
+        self.rows_fetched += uniq.size
+        self.bytes_pulled += uniq.size * self.dim * 4
+        hot_ids, hot_pos, cold_ids, cold_pos = [], [], [], []
+        for i, rid in enumerate(uniq):
+            rid = int(rid)
+            c = self._freq.get(rid, 0) + 1
+            self._freq[rid] = c
+            if rid in self._slot_of:
+                self._touch(rid)
+                hot_ids.append(rid)
+                hot_pos.append(i)
+            else:
+                cold_ids.append(rid)
+                cold_pos.append(i)
+        out = np.empty((uniq.size, self.dim), np.float32)
+        if hot_ids:
+            self.stats["hbm_hits"] += len(hot_ids)
+            slots = np.asarray([self._slot_of[r] for r in hot_ids],
+                               np.int32)
+            out[hot_pos] = np.asarray(self._hot[jnp.asarray(slots)])
+        if cold_ids:
+            # promotion-bound rows are read ONCE via the raw surface
+            # (value + optimizer state together) and never enter the
+            # backing's LRU — a row moving to HBM must not evict a
+            # genuine host-tier row on its way out
+            pos_of = dict(zip(cold_ids, cold_pos))
+            promote = [r for r in cold_ids
+                       if self._freq[r] >= self.admit_after]
+            stay = [r for r in cold_ids
+                    if self._freq[r] < self.admit_after]
+            if stay:
+                before = self._backing_read_stats()
+                out[[pos_of[r] for r in stay]] = self.backing.pull(stay)
+                self._count_backing(before, len(stay))
+            if promote:
+                self._attribute_raw_reads(promote)
+                vecs, g2 = self.backing.read_rows(promote)
+                out[[pos_of[r] for r in promote]] = vecs
+                self._insert_hot(promote, vecs, g2)
+        self._age_freq()
+        return out[inv]
+
+    def lookup(self, ids) -> jnp.ndarray:
+        """Device-array lookup; when EVERY unique id is hot the rows
+        come straight off the device array — the hot set serves at
+        device speed with no host round-trip."""
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        if all(int(r) in self._slot_of for r in uniq):
+            self.pull_count += 1
+            self.ids_seen += ids_np.size
+            self.rows_fetched += uniq.size
+            self.bytes_pulled += uniq.size * self.dim * 4
+            self.stats["hbm_hits"] += uniq.size
+            slots = np.empty(uniq.size, np.int32)
+            for i, rid in enumerate(uniq):
+                rid = int(rid)
+                self._freq[rid] = self._freq.get(rid, 0) + 1
+                self._touch(rid)
+                slots[i] = self._slot_of[rid]
+            return self._hot[jnp.asarray(slots)][jnp.asarray(
+                inv.astype(np.int32))]
+        return jnp.asarray(self.pull(ids_np))
+
+    def push(self, ids, grads) -> None:
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        grads_np = np.asarray(grads, np.float32).reshape(
+            ids_np.size, self.dim)
+        self.push_count += 1
+        self.bytes_pushed += grads_np.nbytes
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads_np)
+        hot_slots, hot_rows, cold_ids, cold_rows = [], [], [], []
+        for i, rid in enumerate(uniq):
+            rid = int(rid)
+            slot = self._slot_of.get(rid)
+            if slot is not None:
+                self._touch(rid)
+                self._dirty.add(rid)      # backing copy is now stale
+                hot_slots.append(slot)
+                hot_rows.append(i)
+            else:
+                cold_ids.append(rid)
+                cold_rows.append(i)
+        if hot_slots:
+            idx = jnp.asarray(np.asarray(hot_slots, np.int32))
+            a = jnp.asarray(acc[hot_rows])
+            if self.optimizer == "adagrad":
+                g2 = self._hot_g2.at[idx].add((a ** 2).mean(axis=1))
+                denom = jnp.sqrt(g2[idx])[:, None] + 1e-10
+                self._hot = self._hot.at[idx].add(-self.lr * a / denom)
+                self._hot_g2 = g2
+            else:
+                self._hot = self._hot.at[idx].add(-self.lr * a)
+        if cold_ids:
+            self.backing.push(cold_ids, acc[cold_rows])
+
+    # -- accounting / reporting --------------------------------------------
+    @property
+    def dedup_ratio(self) -> float:
+        """Mean ids-per-fetched-row since construction (1.0 = no
+        reuse; power-law traffic sits well above it)."""
+        return self.ids_seen / self.rows_fetched if self.rows_fetched \
+            else 1.0
+
+    @property
+    def resident_hot_rows(self) -> int:
+        return len(self._slot_of)
+
+    def device_arrays(self):
+        out = [self._hot]
+        if self.optimizer == "adagrad":
+            out.append(self._hot_g2)
+        return out
+
+    def hbm_bytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.device_arrays())
+
+    def tier_rows(self) -> Dict[str, int]:
+        """Resident row counts per tier (occupancy view)."""
+        out = {"hbm": len(self._slot_of)}
+        b = self.backing
+        if hasattr(b, "resident_rows"):
+            out["host"] = int(b.resident_rows)
+            out["ssd"] = int(getattr(b, "spilled_rows", 0))
+        else:
+            out["host"] = int(getattr(b, "num_rows", 0))
+        return out
+
+    def hit_rates(self) -> Dict[str, float]:
+        """Per-tier share of row fetches, in percent (lazy inits count
+        as SSD-tier reads: the row's home is the log)."""
+        s = self.stats
+        total = (s["hbm_hits"] + s["host_hits"] + s["ssd_reads"]
+                 + s["lazy_inits"])
+        if not total:
+            return {"hbm": 0.0, "host": 0.0, "ssd": 0.0}
+        return {"hbm": 100.0 * s["hbm_hits"] / total,
+                "host": 100.0 * s["host_hits"] / total,
+                "ssd": 100.0 * (s["ssd_reads"] + s["lazy_inits"]) / total}
+
+    def publish_tier_metrics(self, registry=None) -> None:
+        """Tier counters + occupancy gauges into the metrics registry
+        (delta-increments since the last publish, so repeated calls are
+        idempotent over the counter streams)."""
+        from ..monitor import get_registry
+        reg = registry or get_registry()
+        s, p = self.stats, self._published
+        hits = reg.counter(
+            "recsys_tier_hits_total",
+            "embedding row fetches by the tier that served them")
+        for tier, keys in (("hbm", ("hbm_hits",)),
+                           ("host", ("host_hits",)),
+                           ("ssd", ("ssd_reads", "lazy_inits"))):
+            delta = sum(s[k] - p[k] for k in keys)
+            if delta:
+                hits.inc(delta, table=self.name, tier=tier)
+        # emits-metrics: recsys_tier_promotions_total,
+        # emits-metrics: recsys_tier_demotions_total,
+        # emits-metrics: recsys_tier_evictions_total
+        for metric, key, help_ in (
+                ("recsys_tier_promotions_total", "promotions",
+                 "rows promoted into the HBM hot tier"),
+                ("recsys_tier_demotions_total", "demotions",
+                 "rows written back to the backing tier on eviction"),
+                ("recsys_tier_evictions_total", "evictions",
+                 "LRU evictions from the HBM hot tier")):
+            delta = s[key] - p[key]
+            if delta:
+                reg.counter(metric, help_).inc(delta, table=self.name)
+        self._published = dict(s)
+        rows = reg.gauge("recsys_table_rows",
+                         "resident embedding rows per tier")
+        for tier, n in self.tier_rows().items():
+            rows.set(n, table=self.name, tier=tier)
+        rates = reg.gauge("recsys_tier_hit_pct",
+                          "share of row fetches served per tier (%)")
+        for tier, v in self.hit_rates().items():
+            rates.set(v, table=self.name, tier=tier)
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Flat arrays: the hot tier verbatim + the backing state under
+        a ``backing.`` prefix (round-trips through load_state_dict;
+        residency — which rows are hot — survives the trip). Rows never
+        UPDATED are not materialized anywhere (clean evictions skip the
+        write-back), so they re-derive from the backing's deterministic
+        initializer — restore onto a table built with the SAME seed,
+        the SSDSparseTable state_dict contract."""
+        hot_ids = np.asarray(list(self._lru.keys()), np.int64)
+        slots = np.asarray([self._lru[int(r)] for r in hot_ids], np.int32)
+        out = {"hot_ids": hot_ids,
+               "hot_data": np.asarray(self._hot)[slots]
+               if hot_ids.size else np.zeros((0, self.dim), np.float32),
+               "hot_g2": np.asarray(self._hot_g2)[slots]
+               if hot_ids.size else np.zeros((0,), np.float32)}
+        for k, v in self.backing.state_dict().items():
+            out[f"backing.{k}"] = v
+        return out
+
+    def load_state_dict(self, state) -> None:
+        self.backing.load_state_dict(
+            {k[len("backing."):]: v for k, v in state.items()
+             if k.startswith("backing.")})
+        self._hot = jnp.zeros((self.hot_rows, self.dim), jnp.float32)
+        self._hot_g2 = jnp.zeros((self.hot_rows,), jnp.float32)
+        self._slot_of.clear()
+        self._lru.clear()
+        self._dirty.clear()
+        self._free = list(range(self.hot_rows - 1, -1, -1))
+        hot_ids = np.asarray(state.get("hot_ids", []), np.int64)
+        data = np.asarray(state.get("hot_data",
+                                    np.zeros((0, self.dim))), np.float32)
+        g2 = np.asarray(state.get("hot_g2", np.zeros((0,))), np.float32)
+        if hot_ids.size:
+            n = min(hot_ids.size, self.hot_rows)
+            slots = []
+            for i in range(n):
+                slot = self._free.pop()
+                rid = int(hot_ids[i])
+                self._slot_of[rid] = slot
+                self._lru[rid] = slot
+                slots.append(slot)
+            idx = jnp.asarray(np.asarray(slots, np.int32))
+            self._hot = self._hot.at[idx].set(jnp.asarray(data[:n]))
+            self._hot_g2 = self._hot_g2.at[idx].set(jnp.asarray(g2[:n]))
+            # a restored hot row's backing copy (if any) predates the
+            # snapshot's hot value — it must write back on eviction
+            # regardless of future pushes
+            self._dirty.update(int(r) for r in hot_ids[:n])
+            if hot_ids.size > self.hot_rows:
+                # a smaller hot budget demotes the overflow verbatim
+                self.backing.write_rows(hot_ids[n:], data[n:], g2[n:])
+
+    def close(self) -> None:
+        if self._own_backing and hasattr(self.backing, "close"):
+            self.backing.close()

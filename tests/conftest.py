@@ -59,6 +59,17 @@ def pytest_configure(config):
         "fixture forces FLAGS_pallas_interpret for marked tests, so "
         "kernel dispatch serves the real kernels instead of the XLA "
         "fallbacks; fallback stats are reset around every test)")
+    config.addinivalue_line(
+        "markers",
+        "recsys: exercises the paddle_tpu.recsys giant-embedding "
+        "subsystem (tier caches, the table registry, tmp SSD log "
+        "files and RECSYS_STATS are reset around every test by the "
+        "autouse _recsys_isolation fixture)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 wall-clock budget "
+        "(`-m 'not slow'`); full bench legs and other multi-minute "
+        "drills carry it")
 
 
 @pytest.fixture(autouse=True)
@@ -116,6 +127,20 @@ def _serving_isolation():
     if "paddle_tpu.serving" in sys.modules:
         import paddle_tpu.serving as serving
         serving.reset()
+
+
+@pytest.fixture(autouse=True)
+def _recsys_isolation():
+    """Recsys global state (the table registry — whose reset also
+    closes tables owning tmp SSD log files — RECSYS_STATS, live
+    serving-engine queues, the request-id counter) must not leak
+    between tests. Only touches paddle_tpu.recsys when a test
+    imported it."""
+    import sys
+    yield
+    if "paddle_tpu.recsys" in sys.modules:
+        import paddle_tpu.recsys as recsys
+        recsys.reset()
 
 
 @pytest.fixture(autouse=True)

@@ -176,3 +176,40 @@ def test_moe_drop_unit_gates_on_absolute_points_growth():
     assert check_bench.compare(
         [_m("moe_gpt2_tiny_8e_drop_pct", 10.0, "drop%")], down,
         tolerance=0.10) == []
+
+
+def test_recsys_hit_rate_unit_gates_on_absolute_points_drop():
+    """hit% (recsys tier hit rates, BENCH_recsys) is higher-is-better
+    on ABSOLUTE points: a hot tier can legitimately sit anywhere in
+    0-100, so a relative band is meaningless and a collapse must trip
+    even off a small baseline."""
+    old = [_m("recsys_tier_hit_hbm_pct", 40.0, "hit%")]
+    ok = [_m("recsys_tier_hit_hbm_pct", 32.0, "hit%")]     # -8 pts
+    bad = [_m("recsys_tier_hit_hbm_pct", 25.0, "hit%")]    # -15 pts
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "-15.0 points" in problems[0]
+    # direction: a better hit rate never trips
+    up = [_m("recsys_tier_hit_hbm_pct", 90.0, "hit%")]
+    assert check_bench.compare(old, up, tolerance=0.10) == []
+
+
+def test_recsys_examples_per_sec_is_rate_like():
+    """examples/s (DLRM training/serving throughput) gates like
+    tokens/s: relative, shrink = regression."""
+    old = [_m("recsys_dlrm_examples_per_sec", 1000.0, "examples/s")]
+    bad = [_m("recsys_dlrm_examples_per_sec", 850.0, "examples/s")]
+    ok = [_m("recsys_dlrm_examples_per_sec", 1500.0, "examples/s")]
+    assert check_bench.compare(old, bad, tolerance=0.10)
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+
+
+def test_recsys_dedup_ratio_is_higher_is_better():
+    """ratio (dedup ratio — mean ids served per fetched row) regresses
+    when it SHRINKS: a fallen ratio means the lookup stopped merging
+    duplicate ids and row traffic grew."""
+    old = [_m("recsys_dlrm_dedup_ratio", 3.0, "ratio")]
+    bad = [_m("recsys_dlrm_dedup_ratio", 2.0, "ratio")]
+    ok = [_m("recsys_dlrm_dedup_ratio", 3.2, "ratio")]
+    assert check_bench.compare(old, bad, tolerance=0.10)
+    assert check_bench.compare(old, ok, tolerance=0.10) == []

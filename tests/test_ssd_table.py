@@ -103,6 +103,79 @@ def test_compact_reclaims_log(tmp_path):
     t.close()
 
 
+def test_compact_state_dict_roundtrip(tmp_path):
+    """compact() must be invisible to checkpointing: the state_dict
+    before and after a compaction is identical (ids, rows, adagrad
+    slots), and a table restored from the post-compaction state serves
+    the same rows — the log-structured file's live-set contract."""
+    t = SSDSparseTable(2000, 4, cache_rows=8,
+                       path=str(tmp_path / "c.log"), seed=2)
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        ids = rng.integers(0, 100, size=32)
+        t.push(ids, rng.normal(size=(32, 4)).astype(np.float32))
+        t.pull(rng.integers(300, 400, size=16))    # churn + spill
+    before = t.state_dict()
+    t.compact()
+    after = t.state_dict()
+    np.testing.assert_array_equal(before["row_ids"], after["row_ids"])
+    np.testing.assert_array_equal(before["data"], after["data"])
+    np.testing.assert_array_equal(before["g2"], after["g2"])
+    t2 = SSDSparseTable(2000, 4, cache_rows=8,
+                        path=str(tmp_path / "c2.log"), seed=2)
+    t2.load_state_dict(after)
+    np.testing.assert_array_equal(t2.pull(before["row_ids"]),
+                                  t.pull(before["row_ids"]))
+    t.close()
+    t2.close()
+
+
+@pytest.mark.chaos
+def test_ssd_snapshot_torn_commit_falls_back(tmp_path):
+    """The log-structured table's torn-append drill (ISSUE 12): an SSD
+    table snapshotted through the recsys manifest commit survives a
+    chaos ``ckpt.write.torn`` fire — the torn snapshot never reads as
+    valid and restore falls back to the previous committed one."""
+    from paddle_tpu.recsys import load_tables, save_tables
+    from paddle_tpu.testing import chaos
+
+    t = SSDSparseTable(3000, 8, cache_rows=8,
+                       path=str(tmp_path / "s.log"), seed=5)
+    ids = np.arange(50)
+    t.push(ids, np.ones((50, 8), np.float32))
+    t.compact()                                 # snapshot a compacted log
+    want = t.pull(ids).copy()
+    save_tables(str(tmp_path / "snap"), {"ssd": t})
+    t.push(ids, np.ones((50, 8), np.float32))
+    with chaos.chaos_scope("ckpt.write.torn@1"):
+        save_tables(str(tmp_path / "snap"), {"ssd": t})
+    t2 = SSDSparseTable(3000, 8, cache_rows=8,
+                        path=str(tmp_path / "s2.log"), seed=5)
+    path = load_tables(str(tmp_path / "snap"), {"ssd": t2})
+    assert path is not None and path.endswith("tables_1")
+    np.testing.assert_allclose(t2.pull(ids), want, rtol=1e-6, atol=1e-7)
+    t.close()
+    t2.close()
+
+
+def test_raw_row_access_skips_optimizer_and_cache(tmp_path):
+    """read_rows/write_rows (the tier manager's promotion/demotion
+    surface): verbatim values, no gradient math, no cache promotion."""
+    t = SSDSparseTable(1000, 4, cache_rows=4,
+                       path=str(tmp_path / "r.log"), seed=0)
+    t.pull(np.arange(20))                       # spill most rows
+    resident = set(t._cache)
+    cold = [r for r in range(20) if r not in resident][:3]
+    vecs, g2 = t.read_rows(cold)
+    assert set(t._cache) == resident            # no promotion
+    new = np.full((len(cold), 4), 7.0, np.float32)
+    t.write_rows(cold, new, np.full(len(cold), 2.0, np.float32))
+    np.testing.assert_array_equal(t.pull(cold), new)
+    v2, g22 = t.read_rows(cold)
+    np.testing.assert_array_equal(g22, np.full(len(cold), 2.0))
+    t.close()
+
+
 def test_distributed_embedding_over_ssd_table(tmp_path):
     """DistributedEmbedding trains over the SSD backend unchanged
     (protocol compatibility)."""

@@ -47,7 +47,16 @@ _ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%",
 # relative 10% band would hide a 9-point efficiency loss; balance is the
 # MoE expert-load balance (100 = uniform), gated the same way so
 # BENCH_moe trips on routing-health collapse, not just throughput.
-_ABS_POINT_HIGHER_UNITS = {"weak%", "balance"}
+# hit% is a recsys tier hit rate (BENCH_recsys): a drop means the hot
+# set fell out of its tier — a perf cliff even when examples/s survives
+# on a fast host — and a healthy hot tier can sit anywhere in 0-100, so
+# points, not ratios, are the meaningful band.
+_ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%"}
+# recsys rate-like units (BENCH_recsys) ride the default direction:
+# examples/s (training/serving throughput) and ratio (dedup ratio —
+# mean ids served per row fetched, >= 1) are higher-is-better relative,
+# like tokens/s; listed here so the unit table is exhaustive.
+_RATE_UNIT_EXAMPLES = {"examples/s", "ratio"}
 
 
 def _metric_list(record) -> List[dict]:

@@ -28,10 +28,15 @@ stream — prints:
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
   occupancy, queue-depth/slot/page gauges and serving program HBM
   budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
+- with ``--recsys``: the embedding-tier view — per-table occupancy and
+  hit rates across the HBM/host/SSD tiers, promotion/eviction
+  counters, per-table HBM attribution and sharded-lookup fallbacks
+  (``recsys_*`` series from paddle_tpu.recsys; docs/RECSYS.md;
+  rendered next to --serve/--moe);
 - with ``--fallbacks``: every counted degradation in ONE table — scan
-  loop-layout, Pallas-kernel XLA, pipeline sequential-GSPMD and MoE
-  auto-path fallbacks with reason labels ("why is this run slow"
-  starts here, not at four separate counters);
+  loop-layout, Pallas-kernel XLA, pipeline sequential-GSPMD, MoE and
+  recsys auto-path fallbacks with reason labels ("why is this run
+  slow" starts here, not at five separate counters);
 - everything else (counters/gauges) as a flat table.
 
 ``--kernels`` needs no input file: it enumerates the live
@@ -57,7 +62,7 @@ tree with per-span duration, EXCLUSIVE time and the critical path
 (docs/OBSERVABILITY.md "Structured tracing").
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe] [--fallbacks]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe] [--recsys] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
@@ -216,12 +221,81 @@ def _moe_section(latest, used) -> List[str]:
     return out
 
 
+def _recsys_section(latest, used) -> List[str]:
+    """--recsys: per-table tier occupancy, hit rates, promotion/eviction
+    counters and HBM attribution from the ``recsys_*`` gauges the tier
+    manager publishes (docs/RECSYS.md) — the embedding-tier companion
+    to --serve's latency view."""
+    occ: Dict[str, Dict[str, float]] = {}
+    rates: Dict[str, Dict[str, float]] = {}
+    hits: Dict[str, Dict[str, float]] = {}
+    flow: Dict[str, Dict[str, float]] = {}
+    hbm: Dict[str, float] = {}
+    for key, row in latest.items():
+        name, labels = key
+        d = dict(labels)
+        table = str(d.get("table", "-"))
+        tier = str(d.get("tier", "-"))
+        if name == "recsys_table_rows":
+            used.add(key)
+            occ.setdefault(table, {})[tier] = row.get("value", 0.0)
+        elif name == "recsys_tier_hit_pct":
+            used.add(key)
+            rates.setdefault(table, {})[tier] = row.get("value", 0.0)
+        elif name == "recsys_tier_hits_total":
+            used.add(key)
+            hits.setdefault(table, {})[tier] = row.get("value", 0.0)
+        elif name in ("recsys_tier_promotions_total",
+                      "recsys_tier_demotions_total",
+                      "recsys_tier_evictions_total"):
+            used.add(key)
+            flow.setdefault(table, {})[
+                name[len("recsys_tier_"):-len("_total")]] = \
+                row.get("value", 0.0)
+        elif name == "recsys_table_hbm_bytes":
+            used.add(key)
+            hbm[table] = row.get("value", 0.0)
+    rows = []
+    for table in sorted(set(occ) | set(rates) | set(hits) | set(flow)
+                        | set(hbm)):
+        o, r, f = occ.get(table, {}), rates.get(table, {}), \
+            flow.get(table, {})
+        rows.append([
+            table,
+            "/".join(f"{int(o.get(t, 0))}" for t in ("hbm", "host",
+                                                     "ssd")),
+            "/".join(f"{r.get(t, 0.0):.1f}" for t in ("hbm", "host",
+                                                      "ssd")),
+            f"{sum(hits.get(table, {}).values()):g}",
+            f"{f.get('promotions', 0):g}",
+            f"{f.get('evictions', 0):g}",
+            _fmt_bytes(hbm.get(table, 0.0))])
+    out = _table("Recsys embedding tiers (per table)",
+                 ["table", "rows hbm/host/ssd", "hit% hbm/host/ssd",
+                  "fetches", "promoted", "evicted", "HBM bytes"], rows)
+    f_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if name == "recsys_fallback_total":
+            used.add(key)
+            f_rows.append([name, _fmt_labels(labels),
+                           f"{latest[key].get('value', 0):g}"])
+    out += _table("Recsys sharded-lookup fallbacks",
+                  ["counter", "labels", "value"], f_rows)
+    if not rows and not f_rows:
+        out.append("(no recsys_* gauges in this dump — run bench.py "
+                   "--recsys or publish_tier_metrics() first)")
+        out.append("")
+    return out
+
+
 #: the counted-degradation counters every subsystem publishes when its
 #: primary path cannot serve (docs: PERF_TRANSFORMER/PERF_KERNELS/
-#: PARALLELISM/MOE); one table answers "why is this run slow" instead
-#: of four separate counter greps
+#: PARALLELISM/MOE/RECSYS); one table answers "why is this run slow"
+#: instead of five separate counter greps
 _FALLBACK_COUNTERS = ("scan_fallback_total", "pallas_fallback_total",
-                      "pipeline_fallback_total", "moe_fallback_total")
+                      "pipeline_fallback_total", "moe_fallback_total",
+                      "recsys_fallback_total")
 
 
 def _fallbacks_section(latest, used) -> List[str]:
@@ -618,7 +692,8 @@ def render_traces(traces: List[dict], last: int = 10) -> str:
 
 def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
-           moe: bool = False, fallbacks: bool = False) -> str:
+           moe: bool = False, fallbacks: bool = False,
+           recsys: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -630,6 +705,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     comms_out: List[str] = (_comms_section(latest, used) if comms else [])
     # -- MoE router health (--moe) renders next to --comms ---------------
     comms_out += _moe_section(latest, used) if moe else []
+    # -- recsys embedding tiers (--recsys) next to --serve/--moe ---------
+    comms_out += _recsys_section(latest, used) if recsys else []
     # -- unified degradation view (--fallbacks) ---------------------------
     comms_out += _fallbacks_section(latest, used) if fallbacks else []
 
@@ -770,6 +847,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     moe = "--moe" in argv
     if moe:
         argv.remove("--moe")
+    recsys = "--recsys" in argv
+    if recsys:
+        argv.remove("--recsys")
     fallbacks = "--fallbacks" in argv
     if fallbacks:
         argv.remove("--fallbacks")
@@ -807,7 +887,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
-                 moe=moe, fallbacks=fallbacks), end="")
+                 moe=moe, fallbacks=fallbacks, recsys=recsys), end="")
     return 0
 
 
