@@ -622,6 +622,10 @@ def bench_serve(quick: bool = False) -> list:
     trace_overhead = serve_trace_overhead(engine, spec)
     log(f"serve[{name}]: tracing overhead {trace_overhead:.1f}% "
         "(tokens/s at FLAGS_trace_sample=1.0 vs off, same engine)")
+    endpoint_overhead = serve_metrics_endpoint_overhead(engine, spec)
+    log(f"serve[{name}]: /metrics endpoint overhead "
+        f"{endpoint_overhead:.1f}% (tokens/s with a 1 Hz scraper "
+        "attached vs without, same engine)")
     return [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
@@ -643,6 +647,11 @@ def bench_serve(quick: bool = False) -> list:
         # measured form of the docs' tracing-overhead claim
         metric_line("serve_trace_overhead_pct", trace_overhead,
                     "overhead%", vs_baseline=1.0),
+        # same unit/shape as the tracing line: the live telemetry
+        # plane's scrape endpoint must stay ~free or the flag matrix's
+        # "attach Prometheus to production" advice is fiction
+        metric_line("serve_metrics_endpoint_overhead_pct",
+                    endpoint_overhead, "overhead%", vs_baseline=1.0),
     ]
 
 
@@ -672,6 +681,59 @@ def serve_trace_overhead(engine, spec) -> float:
     tps_off = phase(False)
     tps_on = phase(True)
     trace_mod.get_tracer().reset()     # bench must not hold the ring
+    if tps_off <= 0:
+        return 0.0
+    return max(0.0, 100.0 * (tps_off - tps_on) / tps_off)
+
+
+def serve_metrics_endpoint_overhead(engine, spec) -> float:
+    """Measured tokens/s cost of the live telemetry plane's scrape
+    endpoint: two open-loop phases on the SAME warm engine — without a
+    server, then with an embedded AdminServer and a 1 Hz ``/metrics``
+    scraper attached (the Prometheus-attached production shape,
+    docs/OBSERVABILITY.md scrape-interval guidance). Returns
+    max(0, %slower); sub-noise differences clamp to 0 (the overhead%
+    gate in tools/check_bench.py rides ABSOLUTE points)."""
+    import threading
+    import urllib.request
+    from paddle_tpu.monitor.server import AdminServer
+    from paddle_tpu.serving import run_open_loop
+
+    def phase(scraped: bool) -> float:
+        # server bind + scraper-thread startup happen OUTSIDE the timed
+        # region: the metric is the steady-state cost of being scraped,
+        # not the one-time cost of starting the plane
+        srv = th = None
+        stop = threading.Event()
+        if scraped:
+            srv = AdminServer(port=0).start()
+            url = srv.url + "/metrics"
+
+            def scraper():
+                while not stop.is_set():
+                    try:
+                        with urllib.request.urlopen(url, timeout=2) as r:
+                            r.read()
+                    except Exception:
+                        pass            # the load phase is the subject;
+                    stop.wait(1.0)      # a flaky scrape must not abort it
+
+            th = threading.Thread(target=scraper, daemon=True)
+            th.start()
+        tok0 = engine._stats["tokens_generated"]
+        t0 = time.perf_counter()
+        try:
+            run_open_loop(engine, spec)
+        finally:
+            dt = max(time.perf_counter() - t0, 1e-9)
+            if scraped:
+                stop.set()
+                th.join(timeout=2.0)
+                srv.close()
+        return (engine._stats["tokens_generated"] - tok0) / dt
+
+    tps_off = phase(False)
+    tps_on = phase(True)
     if tps_off <= 0:
         return 0.0
     return max(0.0, 100.0 * (tps_off - tps_on) / tps_off)

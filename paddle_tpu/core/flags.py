@@ -331,6 +331,24 @@ define_flag("trace_sample", 0.01,
 define_flag("trace_ring", 64,
             "Capacity of the retained-trace ring (flight-recorder "
             "model: newest N traces survive to a dump/export).")
+define_flag("monitor_port", 0,
+            "TCP port for the embedded admin/telemetry HTTP server "
+            "(paddle_tpu.monitor.server): GET /metrics (Prometheus "
+            "text with exemplars), /healthz, /readyz (503 while the "
+            "serving engine is draining/shedding/watchdog-tripped), "
+            "/statusz (fingerprint, flags, program table, occupancy, "
+            "rates, SLO burn), /debug/flight, /debug/trace "
+            "(?format=perfetto) and /debug/profile?seconds=N (arms a "
+            "live profiler window, returns the chrome trace). Started "
+            "by the serving engine and (opt-in) TrainStep when set; "
+            "-1 = an ephemeral OS-assigned port (tests). 0 (default) "
+            "= OFF: no thread, no socket, no registry writes — the "
+            "zero-overhead contract, pinned by test.")
+define_flag("monitor_host", "127.0.0.1",
+            "Bind address for the admin server. Loopback by default — "
+            "the plane exposes flags, program tables and profiles, so "
+            "exposing it beyond the host is an explicit operator "
+            "decision (front it with real auth if you must).")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -398,12 +398,28 @@ class TrainStep:
         self._programs: Dict[str, dict] = {}
         self._program_memory: Dict[str, Any] = {}
         self._wall_ema: Dict[str, float] = {}
+        self._peak_flops_cache = None
         from ..core.flags import get_flag
         if get_flag("flight_recorder"):
             # crash forensics opt-in: excepthook + faulthandler dump
             # hooks from the first TrainStep on (docs/OBSERVABILITY.md)
             from ..monitor.flight_recorder import get_flight_recorder
             get_flight_recorder().install()
+        if int(get_flag("monitor_port") or 0):
+            # live telemetry plane opt-in for training runs: /metrics,
+            # /statusz (this step registers its stats() as a section),
+            # /debug/profile on the live process. Flag unset = one int
+            # read, nothing else (docs/OBSERVABILITY.md).
+            from ..monitor import server as monitor_server
+            srv = monitor_server.maybe_start_from_flags()
+            if srv is not None:
+                import weakref
+                ref = weakref.ref(self)
+                stale = monitor_server.STALE
+                srv.register_status(
+                    f"train_step-{id(self)}",
+                    lambda: (lambda s: s.stats() if s is not None
+                             else stale)(ref()))
         from ..core.tensor import eager_cache_stats
         from ..utils.compilation import compile_counts
         self._cc0 = compile_counts()
@@ -703,6 +719,24 @@ class TrainStep:
         reg.histogram("train_step_wall_seconds",
                       "full TrainStep.__call__ wall time (host prep + "
                       "dispatch)").observe(wall, kind=kind)
+        # live-plane MFU: the same flops/(wall·peak) arithmetic stats()
+        # computes on demand, published as a gauge so /metrics scrapers
+        # and monitor_top see utilization without calling stats().
+        # Absent on unknown chips (CPU test backend: peak is None).
+        peak = self._peak_flops_cache
+        if peak is None:
+            try:
+                from ..cost_model import device_peak_flops
+                peak = device_peak_flops()
+            except Exception:
+                peak = 0.0
+            self._peak_flops_cache = peak or 0.0
+        flops = self._programs.get(kind, {}).get("flops")
+        if peak and flops:
+            reg.gauge("train_step_mfu",
+                      "model FLOPs utilization by program kind (wall "
+                      "EMA vs chip peak)").set(
+                flops / (self._wall_ema[kind] * peak), kind=kind)
 
     #: _step_span RecordEvent name -> structured-trace span name (the
     #: step-trace taxonomy of docs/OBSERVABILITY.md: dispatch /

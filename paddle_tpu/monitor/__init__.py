@@ -45,6 +45,18 @@ Where-did-the-time-go pillars (ISSUE 11):
    tracking over serving outcomes with SRE-workbook multiwindow alert
    arithmetic.
 
+Live telemetry plane (ISSUE 14 — the pull-while-running half):
+
+10. the **embedded admin server** (:mod:`.server`,
+    ``FLAGS_monitor_port``): ``/metrics`` (Prometheus text with
+    exemplars), ``/healthz`` + ``/readyz`` wired to the serving
+    engine's state machine, ``/statusz``, and on-demand
+    ``/debug/{flight,trace,profile}`` capture from the LIVE process;
+11. the **timeseries ring** (:mod:`.timeseries`): bounded per-scrape
+    registry snapshots turning cumulative counters into rates
+    (``tools/monitor_top.py``), plus **multi-host aggregation**
+    (``MetricsRegistry.merge`` / ``tools/aggregate_metrics.py``).
+
 The registry is always importable and writable; the HOT paths only write
 to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
 the write_count guard in tests/test_monitor.py; the flight recorder has
@@ -52,14 +64,16 @@ the same contract via ``FLAGS_flight_recorder`` and its
 ``record_count`` probe).
 """
 
-from . import flight_recorder, memory, slo, trace  # noqa: F401
+from . import flight_recorder, memory, slo, timeseries, trace  # noqa: F401
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               get_flight_recorder, set_flight_recorder)
 from .memory import (LeakMonitor, MemoryBudgetError,  # noqa: F401
                      ProgramMemory, live_buffer_census, memory_summary,
                      preflight_check)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
-                      get_registry, load_jsonl, scoped_registry)
+                      get_registry, lint_exposition, load_jsonl,
+                      load_registry_jsonl, scoped_registry)
+from .timeseries import TimeseriesRing  # noqa: F401
 from .numerics import (NaNWatchdog, NonFiniteError, all_finite,  # noqa: F401
                        check_numerics, first_nonfinite, nonfinite_entries)
 from .slo import SLOTracker  # noqa: F401
@@ -68,7 +82,8 @@ from .trace import (Span, Trace, Tracer, export_perfetto,  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "scoped_registry", "load_jsonl",
+    "scoped_registry", "load_jsonl", "load_registry_jsonl",
+    "lint_exposition", "TimeseriesRing",
     "NaNWatchdog", "NonFiniteError", "all_finite", "check_numerics",
     "first_nonfinite", "nonfinite_entries",
     "ProgramMemory", "MemoryBudgetError", "LeakMonitor",

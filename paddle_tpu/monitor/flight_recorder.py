@@ -236,15 +236,13 @@ class FlightRecorder:
         d = d or "."
         return os.path.join(d, f"flight_recorder_{os.getpid()}{suffix}")
 
-    def dump(self, path: Optional[str] = None, reason: str = "explicit",
-             trip_step: Optional[int] = None,
-             extra: Optional[dict] = None) -> str:
-        """Serialize fingerprint + flags + ring contents to ``path``
-        (default ``flight_recorder_<pid>.json`` in
-        ``FLAGS_flight_recorder_dir`` or cwd). Overwrites: the newest
-        state of THIS process is the record of interest. Returns the
-        path written."""
-        path = path or self.default_path()
+    def doc(self, reason: str = "explicit",
+            trip_step: Optional[int] = None,
+            extra: Optional[dict] = None) -> dict:
+        """The dump document as a JSON-safe dict — exactly what
+        :meth:`dump` writes. Factored out so the admin server's
+        ``/debug/flight`` serves the SAME payload a crash would leave
+        on disk, without touching the filesystem."""
         with self._lock:
             steps = [dict(r) for r in self._steps]
             events = [dict(r) for r in self._events]
@@ -269,6 +267,18 @@ class FlightRecorder:
                 doc.setdefault(key, _json_safe_tree(provider()))
             except Exception:
                 pass               # the dump itself must still land
+        return doc
+
+    def dump(self, path: Optional[str] = None, reason: str = "explicit",
+             trip_step: Optional[int] = None,
+             extra: Optional[dict] = None) -> str:
+        """Serialize fingerprint + flags + ring contents to ``path``
+        (default ``flight_recorder_<pid>.json`` in
+        ``FLAGS_flight_recorder_dir`` or cwd). Overwrites: the newest
+        state of THIS process is the record of interest. Returns the
+        path written."""
+        path = path or self.default_path()
+        doc = self.doc(reason=reason, trip_step=trip_step, extra=extra)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import re
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -35,6 +36,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "scoped_registry", "load_jsonl",
+    "load_registry_jsonl", "lint_exposition",
 ]
 
 # Prometheus' default latency buckets (seconds), the right shape for both
@@ -50,7 +52,18 @@ def _label_key(labels: Dict[str, Any]) -> _LabelKey:
 
 
 def _escape(v: str) -> str:
+    """Label-VALUE escaping per the Prometheus text exposition spec:
+    backslash, double-quote and newline (in that order — escaping the
+    escapes first, or a pre-escaped ``\\n`` would double)."""
     return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: the spec escapes ONLY backslash and newline
+    there (quotes are legal in prose). An unescaped newline would smear
+    the rest of the help string into a bogus sample line — the
+    unscrapeable-page failure mode the conformance lint exists for."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt_labels(key: _LabelKey, extra: Optional[Tuple[Tuple[str, str], ...]]
@@ -272,27 +285,50 @@ class MetricsRegistry:
         return {m.name: {"type": m.kind, "help": m.help,
                          "samples": m.samples()} for m in metrics}
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition format (v0.0.4)."""
+    def to_prometheus(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format (v0.0.4). With
+        ``exemplars=True``, histogram exemplars render in the
+        OpenMetrics ``# {trace_id="..."}`` suffix syntax on the bucket
+        they landed in — the link from a p99 bucket to a concrete
+        structured trace. The suffix is only legal in OpenMetrics
+        responses (classic text/plain parsers reject it and fail the
+        whole page), so it is OFF by default and the admin server
+        enables it only on Accept-negotiated scrapes. HELP text and
+        label values are escaped per the exposition spec (a stray
+        ``"`` or newline in a label must never produce an unscrapeable
+        page); :func:`lint_exposition` checks the emitted grammar."""
         lines: List[str] = []
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
+
+        def exemplar_suffix(value: dict, le_key: str) -> str:
+            if not exemplars:
+                return ""
+            ex = (value.get("exemplars") or {}).get(le_key)
+            if not ex:
+                return ""
+            return (f' # {{trace_id="{_escape(str(ex["trace_id"]))}"}} '
+                    f'{ex["value"]} {ex["ts"]:.3f}')
+
         for m in metrics:
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for labels, value in m.samples():
                 key = _label_key(labels)
                 if m.kind == "histogram":
                     for le, cum in value["buckets"]:
+                        le_key = repr(float(le))
                         lines.append(
                             f"{m.name}_bucket"
-                            f"{_fmt_labels(key, (('le', repr(float(le))),))}"
-                            f" {cum}")
+                            f"{_fmt_labels(key, (('le', le_key),))}"
+                            f" {cum}"
+                            f"{exemplar_suffix(value, le_key)}")
                     lines.append(
                         f"{m.name}_bucket"
                         f"{_fmt_labels(key, (('le', '+Inf'),))}"
-                        f" {value['count']}")
+                        f" {value['count']}"
+                        f"{exemplar_suffix(value, '+Inf')}")
                     lines.append(f"{m.name}_sum{_fmt_labels(key)} "
                                  f"{value['sum']}")
                     lines.append(f"{m.name}_count{_fmt_labels(key)} "
@@ -300,6 +336,87 @@ class MetricsRegistry:
                 else:
                     lines.append(f"{m.name}{_fmt_labels(key)} {value}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    # -- multi-host aggregation --------------------------------------------
+    def _raw_metric(self, name: str, kind: str, help: str = "",
+                    buckets=None) -> _Metric:
+        """Get-or-create by FULL name (no namespace prefixing) — the
+        merge/loader path, where incoming names are already final."""
+        cls = {"counter": Counter, "gauge": Gauge,
+               "histogram": Histogram}[kind]
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                kw = {"buckets": buckets} if kind == "histogram" else {}
+                m = cls(name, help, self, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"merge brings {kind}")
+            return m
+
+    def merge(self, other: "MetricsRegistry",
+              host: Optional[str] = None) -> None:
+        """Fold ``other``'s series into this registry — the multi-host
+        aggregation primitive behind ``tools/aggregate_metrics.py``
+        (per-host JSONL registries → ONE exposition):
+
+        - **counters** sum per label set (restart-safe: each process
+          segment's total contributes once, so the merged series stays
+          monotonic across a host restart);
+        - **gauges** are last-write-wins; with ``host`` given, every
+          incoming gauge series gains a ``host=<host>`` label so
+          per-host values stay distinguishable instead of silently
+          clobbering each other;
+        - **histograms** merge bucket-wise (per-bucket counts, sum and
+          count add). CONFLICTING bucket boundaries raise ValueError —
+          adding counts across different ``le`` grids would silently
+          corrupt every quantile read off the merged series;
+        - histogram **exemplars** keep the newest per bucket (ts wins).
+        """
+        if other is self:
+            return
+        with other._lock:
+            metrics = list(other._metrics.values())
+        for m in metrics:
+            with other._lock:
+                series = {k: (dict(v) if isinstance(v, dict) else v)
+                          for k, v in m._series.items()}
+            mine = self._raw_metric(
+                m.name, m.kind, m.help,
+                buckets=getattr(m, "buckets", None))
+            if m.kind == "histogram" and mine.buckets != m.buckets:
+                raise ValueError(
+                    f"histogram {m.name!r}: conflicting bucket "
+                    f"boundaries {mine.buckets} vs {m.buckets} — "
+                    "refusing to mis-merge (re-record with one bucket "
+                    "layout, or rename the series)")
+            with self._lock:
+                for k, v in series.items():
+                    if m.kind == "counter":
+                        mine._series[k] = mine._series.get(k, 0.0) \
+                            + float(v)
+                    elif m.kind == "gauge":
+                        key = (k if host is None else _label_key(
+                            dict(dict(k), host=str(host))))
+                        mine._series[key] = float(v)
+                    else:
+                        dst = mine._series.get(k)
+                        if dst is None:
+                            dst = {"counts": [0] * len(mine.buckets),
+                                   "sum": 0.0, "count": 0}
+                            mine._series[k] = dst
+                        for i, c in enumerate(v["counts"]):
+                            dst["counts"][i] += c
+                        dst["sum"] += float(v["sum"])
+                        dst["count"] += int(v["count"])
+                        for le, ex in (v.get("exemplars") or {}).items():
+                            cur = dst.setdefault("exemplars", {}).get(le)
+                            if cur is None or ex.get("ts", 0.0) \
+                                    >= cur.get("ts", 0.0):
+                                dst["exemplars"][le] = dict(ex)
+                    self._write_count += 1
 
     def dump_jsonl(self, path: str, extra: Optional[dict] = None) -> str:
         """Append one JSON line per (metric, label-set) sample.
@@ -342,6 +459,188 @@ def load_jsonl(path: str) -> List[dict]:
             if isinstance(d, dict) and "name" in d:
                 out.append(d)
     return out
+
+
+def load_registry_jsonl(path: str,
+                        registry: Optional[MetricsRegistry] = None) \
+        -> MetricsRegistry:
+    """Rebuild a :class:`MetricsRegistry` from a ``dump_jsonl`` stream.
+
+    Gauges take the NEWEST sample per (name, labels); counters and
+    histograms ACCUMULATE across restart segments *within* the file —
+    an append-only stream whose value drops mid-file means the writer
+    restarted, and the pre-restart segment's total still happened, so
+    it contributes once and the loaded total stays monotonic (the same
+    restart contract :meth:`MetricsRegistry.merge` gives across
+    files). Histogram bucket boundaries must stay consistent within
+    the file (and with any metric already in ``registry``) — a change
+    raises rather than mis-merging; exemplars come from the newest
+    segment. The input half of ``tools/aggregate_metrics.py``."""
+    acc: Dict[Tuple[str, _LabelKey], dict] = {}
+    for row in load_jsonl(path):
+        name = row["name"]
+        kind = row.get("type", "gauge")
+        key = (name, tuple(sorted((k, str(v)) for k, v in
+                                  (row.get("labels") or {}).items())))
+        if kind == "counter":
+            v = float(row.get("value", 0.0))
+            st = acc.get(key)
+            if st is None:
+                acc[key] = {"kind": kind, "base": 0.0, "last": v}
+            else:
+                if v < st["last"]:           # restart: bank the segment
+                    st["base"] += st["last"]
+                st["last"] = v
+        elif kind == "histogram":
+            buckets = tuple(float(le)
+                            for le, _ in (row.get("buckets") or []))
+            if not buckets:
+                continue               # empty histogram: nothing to keep
+            counts, prev = [], 0
+            for _, cum in row["buckets"]:
+                counts.append(int(cum) - prev)
+                prev = int(cum)
+            seg = {"counts": counts, "sum": float(row.get("sum", 0.0)),
+                   "count": int(row.get("count", 0)),
+                   "exemplars": {le: dict(ex) for le, ex in
+                                 (row.get("exemplars") or {}).items()}}
+            st = acc.get(key)
+            if st is None:
+                acc[key] = {"kind": kind, "buckets": buckets,
+                            "base": {"counts": [0] * len(buckets),
+                                     "sum": 0.0, "count": 0},
+                            "last": seg}
+            else:
+                if buckets != st["buckets"]:
+                    raise ValueError(
+                        f"histogram {name!r}: bucket boundaries "
+                        f"changed mid-file in {path} ({st['buckets']} "
+                        f"-> {buckets}) — refusing to mis-merge")
+                if seg["count"] < st["last"]["count"]:
+                    base = st["base"]
+                    for i, c in enumerate(st["last"]["counts"]):
+                        base["counts"][i] += c
+                    base["sum"] += st["last"]["sum"]
+                    base["count"] += st["last"]["count"]
+                st["last"] = seg
+        else:                              # gauge: newest wins
+            acc[key] = {"kind": "gauge",
+                        "value": float(row.get("value", 0.0))}
+    reg = registry if registry is not None else MetricsRegistry()
+    for (name, labels), st in sorted(acc.items()):
+        kind = st["kind"]
+        if kind == "histogram":
+            m = reg._raw_metric(name, kind, buckets=list(st["buckets"]))
+            if st["buckets"] != m.buckets:
+                raise ValueError(
+                    f"histogram {name!r}: {path} carries bucket "
+                    f"boundaries {st['buckets']} but the registry "
+                    f"already holds {m.buckets} — refusing to mis-merge")
+            base, last = st["base"], st["last"]
+            out = {"counts": [b + c for b, c in zip(base["counts"],
+                                                    last["counts"])],
+                   "sum": base["sum"] + last["sum"],
+                   "count": base["count"] + last["count"]}
+            if last["exemplars"]:
+                out["exemplars"] = last["exemplars"]
+            with reg._lock:
+                m._series[labels] = out
+                reg._write_count += 1
+        else:
+            m = reg._raw_metric(name, kind)
+            value = (st["base"] + st["last"] if kind == "counter"
+                     else st["value"])
+            with reg._lock:
+                m._series[labels] = value
+                reg._write_count += 1
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Exposition conformance lint
+# ---------------------------------------------------------------------------
+
+_L_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_L_LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+#: a quoted label value: no raw ", \ or newline; escapes limited to
+#: \\ \" \n (the spec's set — anything else is an invalid escape)
+_L_LABEL_VALUE = r'"(?:[^"\\\n]|\\["\\n])*"'
+_L_LABELS = (rf"\{{{_L_LABEL_NAME}={_L_LABEL_VALUE}"
+             rf"(?:,{_L_LABEL_NAME}={_L_LABEL_VALUE})*,?\}}")
+_L_NUM = r"[+-]?(?:[0-9]+(?:\.[0-9]*)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN)"
+#: OpenMetrics exemplar suffix: ` # {labels} value [ts]`
+_L_EXEMPLAR = rf" # {_L_LABELS} {_L_NUM}(?: {_L_NUM})?"
+_L_SAMPLE_RE = re.compile(
+    rf"^({_L_METRIC_NAME})(?:{_L_LABELS})? {_L_NUM}"
+    rf"(?: [+-]?[0-9]+)?(?:{_L_EXEMPLAR})?$")
+_L_HELP_RE = re.compile(rf"^# HELP ({_L_METRIC_NAME}) (.*)$")
+_L_TYPE_RE = re.compile(
+    rf"^# TYPE ({_L_METRIC_NAME}) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+def _bad_help_escape(text: str) -> bool:
+    """True when HELP text contains an escape other than ``\\\\`` /
+    ``\\n`` (scanned non-overlapping, so ``\\\\`` consumes both chars
+    and a following literal char is not misread as an escape)."""
+    return any(m.group(1) not in ("\\", "n")
+               for m in re.finditer(r"\\(.?)", text))
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Grammar-lint a Prometheus/OpenMetrics text page line by line;
+    returns human-readable problems (empty list = scrapeable). This is
+    the conformance gate behind ``/metrics`` and the exposition tests:
+    every emitted line must parse as a HELP/TYPE comment or a sample
+    (optionally exemplar-suffixed), label values must use only the
+    spec's escape sequences, and sample names must belong to a
+    TYPE-declared family (histogram samples may carry the
+    ``_bucket``/``_sum``/``_count`` suffixes)."""
+    problems: List[str] = []
+    typed: Dict[str, str] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            mh = _L_HELP_RE.match(line)
+            if mh:
+                if _bad_help_escape(mh.group(2)):
+                    problems.append(
+                        f"line {i}: invalid escape in HELP text "
+                        f"(only \\\\ and \\n are legal): {line!r}")
+                continue
+            mt = _L_TYPE_RE.match(line)
+            if mt:
+                name = mt.group(1)
+                if name in typed:
+                    problems.append(
+                        f"line {i}: duplicate TYPE for {name!r}")
+                typed[name] = mt.group(2)
+                continue
+            if line.startswith(("# HELP", "# TYPE")):
+                problems.append(f"line {i}: malformed HELP/TYPE comment: "
+                                f"{line!r}")
+            continue                        # free-form comments are legal
+        ms = _L_SAMPLE_RE.match(line)
+        if ms is None:
+            problems.append(f"line {i}: unparseable sample line: "
+                            f"{line!r}")
+            continue
+        name = ms.group(1)
+        if typed:
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and \
+                        name[:-len(suffix)] in typed:
+                    family = name[:-len(suffix)]
+                    break
+            if family not in typed:
+                problems.append(
+                    f"line {i}: sample {name!r} has no TYPE declaration")
+            elif family != name and typed[family] not in ("histogram",
+                                                          "summary"):
+                problems.append(
+                    f"line {i}: {name!r} uses a histogram suffix but "
+                    f"{family!r} is typed {typed[family]}")
+    return problems
 
 
 # ---------------------------------------------------------------------------

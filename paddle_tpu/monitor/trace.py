@@ -54,7 +54,7 @@ from typing import Any, Dict, Iterator, List, Optional
 __all__ = [
     "Span", "Trace", "Tracer", "get_tracer", "set_tracer", "enabled",
     "start_trace", "current_trace", "activate", "maybe_span",
-    "export_perfetto", "ANOMALY_REASONS", "TRACE_STATS",
+    "export_perfetto", "perfetto_doc", "ANOMALY_REASONS", "TRACE_STATS",
     "reset_trace_stats", "load_trace_dump",
 ]
 
@@ -423,18 +423,11 @@ def maybe_span(name: str, **attrs) -> Iterator[Optional[Span]]:
 # ---------------------------------------------------------------------------
 
 
-def export_perfetto(path: str, traces: Optional[List[dict]] = None,
-                    include_host_timeline: bool = True) -> str:
-    """Write ONE merged Perfetto/chrome-trace JSON: every retained (and
-    open) trace's span tree on its own track, plus the profiler's host
-    ``RecordEvent`` timeline (step spans, ``comm::<op>`` events, eager
-    op dispatches) on per-thread tracks — the unified timeline the
-    reference's device_tracer assembled from CUPTI + host events.
-
-    Timestamps are microseconds in the host ``perf_counter`` domain
-    (both sources share it), emitted sorted per track so the file loads
-    with monotonic track clocks. Openable in ui.perfetto.dev or
-    chrome://tracing."""
+def perfetto_doc(traces: Optional[List[dict]] = None,
+                 include_host_timeline: bool = True) -> dict:
+    """The merged Perfetto/chrome-trace document as a dict — what
+    :func:`export_perfetto` writes. Factored out so the admin server's
+    ``/debug/trace?format=perfetto`` serves it straight from memory."""
     if traces is None:
         traces = get_tracer().snapshot(include_live=True)
     events: List[dict] = []
@@ -474,12 +467,27 @@ def export_perfetto(path: str, traces: Optional[List[dict]] = None,
         except Exception:
             pass
     events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"]))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_perfetto(path: str, traces: Optional[List[dict]] = None,
+                    include_host_timeline: bool = True) -> str:
+    """Write ONE merged Perfetto/chrome-trace JSON: every retained (and
+    open) trace's span tree on its own track, plus the profiler's host
+    ``RecordEvent`` timeline (step spans, ``comm::<op>`` events, eager
+    op dispatches) on per-thread tracks — the unified timeline the
+    reference's device_tracer assembled from CUPTI + host events.
+
+    Timestamps are microseconds in the host ``perf_counter`` domain
+    (both sources share it), emitted sorted per track so the file loads
+    with monotonic track clocks. Openable in ui.perfetto.dev or
+    chrome://tracing."""
+    doc = perfetto_doc(traces, include_host_timeline)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"traceEvents": meta + events,
-                   "displayTimeUnit": "ms"}, f)
+        json.dump(doc, f)
     os.replace(tmp, path)
     return path

@@ -20,7 +20,7 @@ import jax
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "profiler", "start_profiler", "stop_profiler",
            "summary", "profile_train_step", "export_chrome_tracing",
-           "export_tensorboard"]
+           "export_tensorboard", "chrome_trace_doc"]
 
 _tls = threading.local()
 _events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_sec]
@@ -152,15 +152,21 @@ def summary(sorted_by="total"):
     return "\n".join(lines)
 
 
-def _write_chrome_trace(path: str) -> str:
-    import json
-
+def chrome_trace_doc() -> dict:
+    """The host-timeline chrome-trace document as a dict (what
+    ``export_chrome_tracing`` writes) — served in-memory by the admin
+    server's ``/debug/profile`` endpoint."""
     events = [{"name": name, "ph": "X", "ts": ts, "dur": dur,
                "pid": 0, "tid": tid % 100000, "cat": "host"}
               for name, ts, dur, tid in _timeline]
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _write_chrome_trace(path: str) -> str:
+    import json
+
     with open(path, "w") as f:
-        json.dump({"traceEvents": events,
-                   "displayTimeUnit": "ms"}, f)
+        json.dump(chrome_trace_doc(), f)
     return path
 
 

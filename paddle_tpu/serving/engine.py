@@ -209,7 +209,94 @@ class ServingEngine:
             "ttft": [], "tpot": [], "e2e": [], "decode_step": []}
         self._t_first_work: Optional[float] = None
         self._t_last_token: Optional[float] = None
+        #: last watchdog trip (kind/timeout/dispatch) — readiness
+        #: reports it until a later guarded dispatch succeeds
+        self._watchdog_tripped: Optional[dict] = None
         _LIVE_ENGINES.add(self)
+        self._attach_admin()
+
+    # -- live telemetry plane (monitor/server.py; ISSUE 14) ------------------
+    def _attach_admin(self) -> None:
+        """Join the embedded admin plane when ``FLAGS_monitor_port`` is
+        set: /readyz derives from THIS engine's state machine
+        (draining/shedding/watchdog-tripped ⇒ 503) and /statusz gains a
+        section with scheduler occupancy, program attribution and SLO
+        burn. Flag unset (default) = one flag read, no thread, no
+        socket, no registry writes — the zero-overhead contract."""
+        from ..monitor import server as monitor_server
+        self._admin = monitor_server.maybe_start_from_flags()
+        self._admin_key = f"serving_engine_{id(self)}"
+        if self._admin is None:
+            return
+        # weakref'd providers: a collected engine returns the STALE
+        # sentinel so the server PRUNES the registration — never None,
+        # which /readyz would read as "ready" (fail-open). Explicit
+        # shutdown() unregisters instead: that is the drain hand-off,
+        # where the successor engine's own registration takes over.
+        ref = weakref.ref(self)
+        stale = monitor_server.STALE
+        self._admin.register_readiness(
+            self._admin_key,
+            lambda: (lambda e: stale if e is None else e._readiness())(
+                ref()))
+        self._admin.register_status(
+            self._admin_key,
+            lambda: (lambda e: stale if e is None
+                     else e._admin_status())(ref()))
+
+    def _readiness(self) -> Optional[dict]:
+        """None while this engine should receive traffic; otherwise a
+        JSON reason derived from the serving state machine
+        (docs/SERVING.md): the load balancer's signal to pull this
+        replica. Reads live state only — a state transition is visible
+        to /readyz within the same iteration it happens."""
+        if self._drained:
+            return {"state": "drained",
+                    "detail": "engine drained; hand traffic to the "
+                              "successor"}
+        if self._draining or (self._drain_latch is not None
+                              and self._drain_latch.triggered):
+            return {"state": "draining",
+                    "queue_depth": self.scheduler.queue_depth,
+                    "active_slots": len(self.scheduler.active())}
+        if self._overload is not None and self._overload.overloaded:
+            return {"state": "shedding",
+                    "ewma_s": round(self._overload.ewma_s, 4),
+                    "threshold_s": self._overload.threshold_s,
+                    "queue_depth": self.scheduler.queue_depth}
+        if self._watchdog_tripped is not None:
+            return dict(self._watchdog_tripped,
+                        state="watchdog-tripped")
+        return None
+
+    def _admin_status(self) -> dict:
+        """/statusz section: the live engine picture an operator reads
+        before deciding to drain/restart — occupancy, outcome stats,
+        program FLOPs/HBM attribution, SLO burn."""
+        d: dict = {
+            "scheduler": self.scheduler.state(),
+            "kv_pages_in_use": self.cache.allocator.pages_in_use,
+            "kv_pages_total": self.cache.allocator.num_pages,
+            "engine_stats": dict(self._stats),
+            "programs": dict(self._programs_info),
+            "draining": self._draining,
+            "drained": self._drained,
+            "overloaded": (self._overload.overloaded
+                           if self._overload is not None else False),
+            "watchdog_tripped": self._watchdog_tripped,
+        }
+        if self._slo_avail is not None:
+            d["slo_availability"] = self._slo_avail.snapshot()
+        if self._slo_deadline is not None:
+            d["slo_deadline"] = self._slo_deadline.snapshot()
+        return d
+
+    def _detach_admin(self) -> None:
+        admin = getattr(self, "_admin", None)
+        if admin is not None:
+            admin.unregister_readiness(self._admin_key)
+            admin.unregister_status(self._admin_key)
+            self._admin = None
 
     # -- program construction ----------------------------------------------
     def _next_key(self):
@@ -724,6 +811,12 @@ class ServingEngine:
             self._watchdog_threads.append(worker.thread)
         result = worker.dispatch(job, timeout_s)
         if result is None:
+            # /readyz reports the trip until a later guarded dispatch
+            # succeeds — a replica whose chip is hanging must drop out
+            # of the load balancer, not keep absorbing traffic
+            self._watchdog_tripped = {
+                "kind": kind, "timeout_s": timeout_s,
+                "dispatch": self._dispatch_seq}
             n_active = len(self.scheduler.active())
             for _, st in self.scheduler.active():
                 # tail-based sampling: every request aboard a tripped
@@ -754,7 +847,8 @@ class ServingEngine:
             raise DecodeWatchdogError(kind, timeout_s,
                                       self._dispatch_seq, n_active,
                                       retry_safe=retry_safe)
-        if "error" in result:
+        self._watchdog_tripped = None      # guarded dispatch returned:
+        if "error" in result:              # the chip answers again
             raise result["error"]
         return result["value"]
 
@@ -1043,8 +1137,9 @@ class ServingEngine:
 
     def shutdown(self) -> None:
         """Drop compiled programs, cache pools, the drain latch (signal
-        handlers restored) and any live watchdog threads (test isolation
-        / explicit teardown)."""
+        handlers restored), admin-plane registrations and any live
+        watchdog threads (test isolation / explicit teardown)."""
+        self._detach_admin()
         if self._drain_latch is not None:
             self._drain_latch.close()
             self._drain_latch = None

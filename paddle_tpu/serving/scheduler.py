@@ -437,6 +437,34 @@ class Scheduler:
         return [(i, st) for i, st in enumerate(self.slots)
                 if st is not None]
 
+    def state(self) -> dict:
+        """Lifecycle snapshot for the admin plane (``/statusz`` and the
+        engine's readiness reason bodies): queue depth, per-slot
+        residency and the cumulative outcome stats. Called from HTTP
+        handler threads at arbitrary times, so it works on one-shot
+        ``list()`` copies of the queue/slot lists (atomic under the
+        GIL) — the serving loop may mutate them mid-render and the
+        snapshot must stay internally consistent, never raise."""
+        now = self.clock()
+        waiting = list(self.waiting)
+        slots = list(self.slots)
+        oldest = min((st.submitted_t for st in waiting), default=None)
+        return {
+            "queue_depth": len(waiting),
+            "oldest_waiting_s": (max(0.0, now - oldest)
+                                 if oldest is not None else None),
+            "active_slots": sum(1 for st in slots if st is not None),
+            "max_slots": len(slots),
+            "slots": [
+                {"slot": slot, "request_id": st.request.request_id,
+                 "prompt_len": st.prompt_len,
+                 "generated": len(st.generated),
+                 "seq_len": st.seq_len,
+                 "preemptions": st.preemptions}
+                for slot, st in enumerate(slots) if st is not None],
+            "stats": dict(self.stats),
+        }
+
     @property
     def has_work(self) -> bool:
         return bool(self.waiting) or any(

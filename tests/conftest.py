@@ -144,6 +144,18 @@ def _recsys_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _admin_server_isolation():
+    """The embedded admin HTTP server (monitor/server.py) must not
+    leak threads/sockets or provider registrations between tests.
+    Only touches the module when a test imported it."""
+    import sys
+    yield
+    mod = sys.modules.get("paddle_tpu.monitor.server")
+    if mod is not None:
+        mod.stop_server()
+
+
+@pytest.fixture(autouse=True)
 def _trace_isolation():
     """Structured-tracer state (retained ring, live traces, allocation
     probe) must not leak between tests — the zero-overhead pin reads

@@ -1,0 +1,767 @@
+"""Live telemetry plane (ISSUE 14): the embedded admin HTTP server
+(monitor/server.py), exposition conformance + exemplars, registry
+merge, the timeseries ring, and the monitor_top / aggregate_metrics
+tools (docs/OBSERVABILITY.md "Live telemetry plane")."""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+from paddle_tpu.monitor import get_registry, scoped_registry
+from paddle_tpu.monitor import server as server_mod
+from paddle_tpu.monitor.metrics import (MetricsRegistry,
+                                        lint_exposition,
+                                        load_registry_jsonl)
+from paddle_tpu.monitor.server import AdminServer
+from paddle_tpu.monitor.timeseries import (TimeseriesRing,
+                                           parse_prometheus)
+from paddle_tpu.serving import (LoadSpec, Request, ServingConfig,
+                                ServingEngine, build_requests,
+                                run_open_loop)
+from paddle_tpu.testing import chaos
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return GPTForPretraining(gpt_tiny())
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _engine(model, clock=None, **kw):
+    cfg = dict(max_batch_slots=3, block_size=4, max_context_len=64,
+               prefill_buckets=(8, 16), batch_buckets=(1, 2))
+    cfg.update(kw)
+    kw2 = {"clock": clock} if clock is not None else {}
+    return ServingEngine(model, ServingConfig(**cfg), **kw2)
+
+
+def _get(url, path, timeout=10):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(url, path):
+    st, body = _get(url, path)
+    return st, json.loads(body)
+
+
+@pytest.fixture
+def admin():
+    srv = AdminServer(port=0).start()
+    yield srv
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance (satellite: escaping + lint)
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_escapes_hostile_label_values():
+    """A label containing ``"``, ``\\`` or a newline must produce a
+    lint-clean (scrapeable) page — the pre-fix emitter produced raw
+    values here."""
+    reg = MetricsRegistry()
+    reg.counter("evil_total", "counts").inc(
+        reason='say "no"\nand \\ survive', op="a,b{}")
+    reg.gauge("g_metric", 'help with "quotes", \\ and\nnewline').set(1)
+    text = reg.to_prometheus()
+    assert lint_exposition(text) == []
+    # the escaped forms are on the page; no raw newline smears a sample
+    assert r'say \"no\"\nand \\ survive' in text
+    assert "\nand \\ survive" not in text
+
+
+def test_exposition_lint_catches_bad_grammar():
+    assert lint_exposition('m{l="a\nb"} 1\n')        # raw newline
+    assert lint_exposition('m{l="a\\q"} 1\n')        # bad escape
+    assert lint_exposition("m{} x\n")                # non-numeric value
+    assert lint_exposition("# TYPE m bogus_kind\n")
+    assert lint_exposition("# TYPE m counter\nother_name 1\n")
+    assert lint_exposition("# TYPE m counter\n"
+                           "# TYPE m counter\nm 1\n")  # duplicate TYPE
+    # suffix on a non-histogram family
+    assert lint_exposition("# TYPE m counter\nm_bucket 1\n")
+    ok = ('# HELP m does things\n# TYPE m counter\n'
+          'm{l="x"} 3.5 # {trace_id="t-1"} 0.1 12345\n')
+    assert lint_exposition(ok) == []
+
+
+def test_exposition_renders_exemplars():
+    """With ``exemplars=True`` (the OpenMetrics-negotiated form),
+    histogram exemplars land on their bucket line in the
+    ``# {trace_id="..."}`` suffix syntax — and the page still lints.
+    The default page is classic text and must NOT carry the suffix
+    (plain Prometheus parsers reject it)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="trace-a")
+    h.observe(7.0, exemplar="trace-b")      # past the last bucket: +Inf
+    plain = reg.to_prometheus()
+    assert lint_exposition(plain) == [] and "trace_id" not in plain
+    text = reg.to_prometheus(exemplars=True)
+    assert lint_exposition(text) == []
+    bucket_lines = [ln for ln in text.splitlines() if "_bucket" in ln]
+    assert any('le="0.1"' in ln and '# {trace_id="trace-a"} 0.05' in ln
+               for ln in bucket_lines)
+    assert any('le="+Inf"' in ln and 'trace_id="trace-b"' in ln
+               for ln in bucket_lines)
+
+
+def test_whole_default_registry_exposition_lints_after_serve(tiny_model):
+    """End-to-end conformance: everything a serve run writes into the
+    registry exports as a lint-clean page."""
+    with scoped_registry() as reg:
+        eng = _engine(tiny_model)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            eng.submit(Request(rng.integers(2, 250, (5,)),
+                               max_new_tokens=3))
+        eng.run()
+        assert lint_exposition(reg.to_prometheus()) == []
+
+
+# ---------------------------------------------------------------------------
+# Registry.merge — the multi-host aggregation primitive (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_sums_counters_and_monotonic_across_restart():
+    """Counter merge after a process restart: each segment's total
+    contributes once, and the merged value never decreases as more
+    segments fold in (monotonicity)."""
+    merged = MetricsRegistry()
+    seen = []
+    for segment_total in (100.0, 30.0, 7.0):   # restart resets to 0
+        seg = MetricsRegistry()
+        seg.counter("req_total").inc(segment_total, route="gen")
+        merged.merge(seg)
+        seen.append(merged.get("req_total").value(route="gen"))
+    assert seen == [100.0, 130.0, 137.0]
+    assert seen == sorted(seen)
+
+
+def test_merge_gauges_host_label_disambiguation():
+    a, b, merged = (MetricsRegistry() for _ in range(3))
+    a.gauge("queue_depth").set(3)
+    b.gauge("queue_depth").set(11)
+    merged.merge(a, host="hostA")
+    merged.merge(b, host="hostB")
+    g = merged.get("queue_depth")
+    assert g.value(host="hostA") == 3.0
+    assert g.value(host="hostB") == 11.0
+    # without a host label, last write wins (documented)
+    plain = MetricsRegistry()
+    plain.merge(a)
+    plain.merge(b)
+    assert plain.get("queue_depth").value() == 11.0
+
+
+def test_merge_histograms_bucketwise_and_exemplar_newest_wins():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    ha = a.histogram("lat_seconds", buckets=(0.1, 1.0))
+    hb = b.histogram("lat_seconds", buckets=(0.1, 1.0))
+    ha.observe(0.05)
+    ha.observe(0.5, exemplar="old")
+    hb.observe(0.5, exemplar="new")
+    hb.observe(2.0)
+    merged = MetricsRegistry()
+    merged.merge(a)
+    merged.merge(b)
+    h = merged.get("lat_seconds")
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(3.05)
+    (_, sample), = [(k, v) for k, v in h.samples()]
+    assert sample["buckets"] == [[0.1, 1], [1.0, 3]]
+    assert h.exemplars()[repr(1.0)]["trace_id"] == "new"
+
+
+def test_merge_conflicting_bucket_boundaries_raises():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    b.histogram("lat_seconds", buckets=(0.25, 4.0)).observe(0.5)
+    merged = MetricsRegistry()
+    merged.merge(a)
+    with pytest.raises(ValueError, match="conflicting bucket"):
+        merged.merge(b)
+    # the failed merge didn't corrupt the existing series
+    assert merged.get("lat_seconds").count() == 1
+
+
+def test_merge_kind_clash_raises():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("x_total").inc()
+    b.gauge("x_total").set(1)
+    merged = MetricsRegistry()
+    merged.merge(a)
+    with pytest.raises(TypeError):
+        merged.merge(b)
+
+
+def test_load_registry_jsonl_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(5, op="x")
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="t1")
+    h.observe(0.5)
+    reg.gauge("g_depth").set(2)
+    p = str(tmp_path / "host.jsonl")
+    reg.dump_jsonl(p)
+    reg.gauge("g_depth").set(9)          # newest sample must win
+    reg.dump_jsonl(p)
+    back = load_registry_jsonl(p)
+    assert back.get("c_total").value(op="x") == 5.0
+    assert back.get("g_depth").value() == 9.0
+    assert back.get("h_seconds").count() == 2
+    assert back.get("h_seconds").exemplars()[repr(0.1)]["trace_id"] \
+        == "t1"
+    assert lint_exposition(back.to_prometheus()) == []
+
+
+def test_load_registry_jsonl_restart_segments_accumulate(tmp_path):
+    """One append-only file spanning a process restart: the value drop
+    marks the segment boundary, and BOTH segments' totals contribute —
+    the loaded counter/histogram never regresses versus an earlier
+    aggregation of the same stream (gauges still take the newest)."""
+    p = str(tmp_path / "host.jsonl")
+    seg1 = MetricsRegistry()
+    seg1.counter("req_total").inc(1000)
+    h1 = seg1.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h1.observe(0.05)
+    h1.observe(0.5)
+    seg1.gauge("depth").set(7)
+    seg1.dump_jsonl(p)
+    seg2 = MetricsRegistry()                 # restart: counts from 0
+    seg2.counter("req_total").inc(50)
+    seg2.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(
+        0.05, exemplar="post-restart")
+    seg2.gauge("depth").set(2)
+    seg2.dump_jsonl(p)
+    back = load_registry_jsonl(p)
+    assert back.get("req_total").value() == 1050.0
+    h = back.get("lat_seconds")
+    assert h.count() == 3 and h.sum() == pytest.approx(0.6)
+    assert h.exemplars()[repr(0.1)]["trace_id"] == "post-restart"
+    assert back.get("depth").value() == 2.0  # gauges: newest wins
+    # boundary change mid-file is a conflict, never a silent mis-merge
+    seg3 = MetricsRegistry()
+    seg3.histogram("lat_seconds", buckets=(0.25,)).observe(0.1)
+    seg3.dump_jsonl(p)
+    with pytest.raises(ValueError, match="changed mid-file"):
+        load_registry_jsonl(p)
+
+
+def test_aggregate_metrics_tool(tmp_path, capsys):
+    import aggregate_metrics
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("tok_total").inc(10)
+    a.gauge("depth").set(1)
+    b.counter("tok_total").inc(4)
+    b.gauge("depth").set(6)
+    pa, pb = str(tmp_path / "hostA.jsonl"), str(tmp_path / "hostB.jsonl")
+    a.dump_jsonl(pa)
+    b.dump_jsonl(pb)
+    assert aggregate_metrics.main([pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "tok_total 14.0" in out
+    assert 'depth{host="hostA"} 1.0' in out
+    assert 'depth{host="hostB"} 6.0' in out
+    assert lint_exposition(out) == []
+    assert "trace_id" not in out            # classic page by default
+    assert aggregate_metrics.main(["--openmetrics", pa, pb]) == 0
+    om = capsys.readouterr().out
+    assert lint_exposition(om) == [] and om.endswith("# EOF\n")
+    # conflicting buckets across hosts: exit 1, loud
+    c = MetricsRegistry()
+    c.histogram("h_seconds", buckets=(0.5,)).observe(0.1)
+    d = MetricsRegistry()
+    d.histogram("h_seconds", buckets=(0.9,)).observe(0.1)
+    pc, pd = str(tmp_path / "c.jsonl"), str(tmp_path / "d.jsonl")
+    c.dump_jsonl(pc)
+    d.dump_jsonl(pd)
+    assert aggregate_metrics.main([pc, pd]) == 1
+    assert "MERGE CONFLICT" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Timeseries ring
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_rate_delta_and_window():
+    reg = MetricsRegistry()
+    c = reg.counter("tok_total")
+    clock = ManualClock()
+    ring = TimeseriesRing(capacity=16, clock=clock)
+    for inc in (10, 10, 40):
+        c.inc(inc)
+        ring.snapshot(reg)
+        clock.advance(1.0)
+    assert ring.rate("tok_total") == pytest.approx(25.0)   # 50 over 2s
+    assert ring.rate("tok_total", window_s=1.0) == pytest.approx(40.0)
+    assert ring.delta("tok_total") == pytest.approx(50.0)
+    assert ring.latest("tok_total") == 60.0
+    assert ring.rate("tok_total", missing="x") is None     # unknown key
+    assert ring.rates() == {"tok_total": pytest.approx(25.0)}
+
+
+def test_timeseries_counter_reset_fold():
+    """A writer restart (value drops) must not produce a negative
+    rate; the post-reset segment counts from its own baseline."""
+    clock = ManualClock()
+    ring = TimeseriesRing(clock=clock)
+    for v in (100.0, 110.0, 5.0, 20.0):
+        ring._ingest([("c_total", {}, "counter", v)], clock.t)
+        clock.advance(1.0)
+    # segments: +10, (reset), +15 over 3s
+    assert ring.rate("c_total") == pytest.approx(25.0 / 3.0)
+    assert ring.rate("c_total") >= 0
+
+
+def test_timeseries_histogram_flattening_and_capacity():
+    reg = MetricsRegistry()
+    h = reg.histogram("e2e_seconds", buckets=(1.0,))
+    clock = ManualClock()
+    ring = TimeseriesRing(capacity=4, clock=clock)
+    for i in range(10):
+        h.observe(0.5)
+        ring.snapshot(reg)
+        clock.advance(1.0)
+    assert ring.kind("e2e_seconds_count") == "counter"
+    pts = ring.series("e2e_seconds_count")
+    assert len(pts) == 4                    # bounded ring
+    assert ring.rate("e2e_seconds_count") == pytest.approx(1.0)
+    # windowed mean latency from the two flattened series
+    mean = ring.delta("e2e_seconds_sum") / ring.delta("e2e_seconds_count")
+    assert mean == pytest.approx(0.5)
+
+
+def test_parse_prometheus_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "h").inc(2, op='say "hi"\n\\')
+    # literal backslash followed by 'n': escapes to \\n on the page and
+    # must decode back to \ + n, NOT a newline (single-pass unescape)
+    reg.counter("path_total").inc(1, dir="logs\\nightly")
+    reg.gauge("b_depth").set(-1.5)
+    h = reg.histogram("c_seconds", buckets=(0.1,))
+    h.observe(0.05, exemplar="t9")
+    rows = parse_prometheus(reg.to_prometheus())
+    d = {(r["name"], tuple(sorted(r["labels"].items()))): r
+         for r in rows}
+    assert d[("a_total", (("op", 'say "hi"\n\\'),))]["value"] == 2.0
+    assert d[("path_total", (("dir", "logs\\nightly"),))]["value"] == 1.0
+    assert d[("b_depth", ())]["value"] == -1.5
+    assert d[("c_seconds_count", ())]["type"] == "counter"
+    assert not any(n.endswith("_bucket") for n, _ in d)
+
+
+# ---------------------------------------------------------------------------
+# Admin server endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_endpoint_lints_and_feeds_ring(admin):
+    with scoped_registry() as reg:
+        reg.counter("demo_total", "demo").inc(3, op="x")
+        reg.histogram("demo_seconds", buckets=(0.1,)).observe(
+            0.05, exemplar="t1")
+        st, body = _get(admin.url, "/metrics")
+        assert st == 200
+        text = body.decode()
+        assert lint_exposition(text) == []
+        # classic text/plain page: NO exemplar suffix (the 0.0.4
+        # parser real Prometheus selects from the Content-Type would
+        # reject it and fail the whole scrape)
+        assert "trace_id" not in text
+        reg.counter("demo_total").inc(5, op="x")
+        _get(admin.url, "/metrics")
+        # the plane's own traffic is counted (in the active registry)
+        assert reg.get("monitor_http_requests_total") \
+            .value(path="/metrics") == 2
+    assert admin.ring.snapshots_taken == 2
+    assert admin.ring.delta("demo_total", op="x") == 5.0
+
+
+def test_metrics_endpoint_openmetrics_negotiation(admin):
+    """An Accept: application/openmetrics-text scrape gets the
+    exemplar-carrying OpenMetrics page with the # EOF trailer."""
+    with scoped_registry() as reg:
+        reg.histogram("demo_seconds", buckets=(0.1,)).observe(
+            0.05, exemplar="t1")
+        req = urllib.request.Request(
+            admin.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "application/openmetrics-text" in \
+                r.headers["Content-Type"]
+            text = r.read().decode()
+    assert lint_exposition(text) == []
+    assert '# {trace_id="t1"} 0.05' in text
+    assert text.endswith("# EOF\n")
+
+
+def test_healthz_readyz_and_providers(admin):
+    assert _get(admin.url, "/healthz")[0] == 200
+    st, doc = _get_json(admin.url, "/readyz")
+    assert st == 200 and doc["ready"] is True
+    admin.register_readiness("engine", lambda: {"state": "draining"})
+    admin.register_readiness("other", lambda: None)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(admin.url, "/readyz")
+    assert ei.value.code == 503
+    doc = json.loads(ei.value.read())
+    assert doc["ready"] is False
+    assert doc["reasons"]["engine"]["state"] == "draining"
+    assert "other" not in doc["reasons"]
+    # a raising provider reports, never 500s the endpoint
+    admin.unregister_readiness("engine")
+    admin.register_readiness("broken",
+                             lambda: (_ for _ in ()).throw(OSError("x")))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(admin.url, "/readyz")
+    assert json.loads(ei.value.read())["reasons"]["broken"][
+        "state"] == "provider-error"
+    admin.unregister_readiness("broken")
+    assert _get(admin.url, "/readyz")[0] == 200
+
+
+def test_statusz_sections_flags_fingerprint(admin):
+    admin.register_status("mything", lambda: {"answer": 42})
+    admin.register_status("gone", lambda: None)   # stale: dropped
+    st, doc = _get_json(admin.url, "/statusz")
+    assert st == 200
+    assert doc["sections"]["mything"]["answer"] == 42
+    assert "gone" not in doc["sections"]
+    assert "monitor_port" in doc["flags"]
+    assert doc["fingerprint"]["pid"] == os.getpid()
+    assert "per_second" in doc["rates"]
+    # the stale provider was dropped from the table, not just skipped
+    with admin._lock:
+        assert "gone" not in admin._status
+
+
+def test_debug_flight_matches_crash_dump(admin, tmp_path):
+    from paddle_tpu.monitor.flight_recorder import get_flight_recorder
+    fr = get_flight_recorder()
+    fr.record_event("chaos", site="x")
+    fr.record_step(7, loss=1.5, kind="step")
+    st, doc = _get_json(admin.url, "/debug/flight")
+    assert st == 200
+    on_disk = json.load(open(fr.dump(str(tmp_path / "d.json"))))
+    # same document a crash would dump (modulo reason/timestamps)
+    assert doc["steps"] == on_disk["steps"]
+    assert doc["events"] == on_disk["events"]
+    assert doc["fingerprint"] == on_disk["fingerprint"]
+    assert doc["reason"] == "admin_endpoint"
+
+
+def test_debug_trace_json_and_perfetto(admin):
+    from paddle_tpu.monitor import trace as trace_mod
+    with flag_scope("trace", True), flag_scope("trace_sample", 1.0):
+        tr = trace_mod.start_trace("unit.work", request_id=1)
+        sp = tr.start_span("phase")
+        tr.end_span(sp)
+        trace_mod.get_tracer().finish_trace(tr)
+        st, doc = _get_json(admin.url, "/debug/trace")
+        assert st == 200
+        assert any(t["name"] == "unit.work" for t in doc["traces"])
+        st, pdoc = _get_json(admin.url, "/debug/trace?format=perfetto")
+        assert st == 200
+        names = {e.get("name") for e in pdoc["traceEvents"]}
+        assert "phase" in names
+
+
+def test_debug_profile_returns_chrome_trace(admin):
+    from paddle_tpu import profiler as prof
+    st, doc = _get_json(admin.url, "/debug/profile?seconds=0.05")
+    assert st == 200
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["captureSeconds"] == pytest.approx(0.05)
+    assert not prof._active[0]            # window closed after capture
+    # a concurrent user profiler session is refused, not corrupted
+    prof.start_profiler()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(admin.url, "/debug/profile?seconds=0.05")
+        assert ei.value.code == 409
+    finally:
+        prof.stop_profiler()
+
+
+def test_unknown_endpoint_404s(admin):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(admin.url, "/nope")
+    assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# Engine lifecycle + readiness (the acceptance drill)
+# ---------------------------------------------------------------------------
+
+
+def _ready_reason(url):
+    """(status, reason-dict-or-None) from /readyz, one engine max."""
+    try:
+        st, doc = _get_json(url, "/readyz")
+        return st, None
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        doc = json.loads(e.read())
+        reasons = [v for k, v in doc["reasons"].items()
+                   if k.startswith("serving_engine")]
+        return 503, reasons[0] if reasons else None
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_live_engine_admin_plane_acceptance(tiny_model):
+    """ISSUE 14 acceptance: a live engine under the chaos loadgen
+    answers /metrics (conformance-lint clean), flips /readyz to 503
+    within one iteration of entering shedding/draining and back on
+    exit, and serves /debug/profile as valid chrome-trace JSON."""
+    chaos.configure("serve.request.poison@2", seed=0)
+    clock = ManualClock()
+    with flag_scope("monitor_port", -1), scoped_registry() as reg:
+        eng = _engine(tiny_model, clock=clock, max_batch_slots=1,
+                      overload_threshold_s=1.0, overload_alpha=1.0,
+                      slo_availability=0.99)
+        srv = server_mod.get_server()
+        assert srv is not None and srv.running
+        url = srv.url
+        # -- drive the bursty chaos loadgen through the engine ----------
+        schedule = build_requests(LoadSpec(
+            num_requests=6, rate_rps=50.0, arrival="mmpp",
+            burstiness=2.0, prompt_len_range=(4, 8),
+            max_new_range=(2, 3), vocab_size=256, seed=1))
+        for _, req in schedule:
+            eng.submit(req)
+        eng.run()
+        # -- /metrics: serve series present, page lint-clean ------------
+        st, body = _get(url, "/metrics")
+        assert st == 200
+        text = body.decode()
+        assert lint_exposition(text) == []
+        assert "serve_tokens_generated_total" in text
+        assert "slo_burn_rate" in text
+        # chaos poisoned ≥1 request: its failure is on the page
+        assert reg.get("serve_requests_total").value(event="failed") >= 1
+        # -- shedding flips /readyz within the iteration it enters ------
+        assert _ready_reason(url)[0] == 200
+        eng.submit(Request(np.arange(1, 6), max_new_tokens=3))
+        eng.submit(Request(np.arange(1, 6), max_new_tokens=3))
+        eng.step()
+        clock.advance(5.0)               # head-of-queue delay blows up
+        eng.step()                       # detector enters shedding HERE
+        assert eng._overload.overloaded
+        st, reason = _ready_reason(url)
+        assert st == 503 and reason["state"] == "shedding"
+        eng.run()                        # drain queue; EWMA decays
+        for _ in range(8):
+            eng.step()
+        assert not eng._overload.overloaded
+        assert _ready_reason(url)[0] == 200     # ...and back on exit
+        # -- /debug/profile on the live process -------------------------
+        st, doc = _get_json(url, "/debug/profile?seconds=0.05")
+        assert st == 200 and isinstance(doc["traceEvents"], list)
+        json.dumps(doc)                  # valid chrome-trace JSON
+        # -- statusz carries the engine section -------------------------
+        st, sdoc = _get_json(url, "/statusz")
+        sect = [v for k, v in sdoc["sections"].items()
+                if k.startswith("serving_engine")]
+        assert sect and sect[0]["scheduler"]["stats"]["completed"] >= 5
+        assert "slo_availability" in sect[0]
+        eng.shutdown()
+
+
+@pytest.mark.serve
+def test_readyz_flips_on_draining_and_drained(tiny_model, tmp_path):
+    clock = ManualClock()
+    with flag_scope("monitor_port", -1):
+        eng = _engine(tiny_model, clock=clock,
+                      drain_dir=str(tmp_path / "drain"))
+        url = server_mod.get_server().url
+        assert _ready_reason(url)[0] == 200
+        eng._draining = True             # the submit()-visible state
+        st, reason = _ready_reason(url)
+        assert st == 503 and reason["state"] == "draining"
+        eng._draining = False
+        eng.drain()                      # no pending work: clean drain
+        st, reason = _ready_reason(url)
+        assert st == 503 and reason["state"] == "drained"
+        eng.shutdown()
+
+
+@pytest.mark.serve
+def test_readyz_reports_watchdog_trip(tiny_model):
+    with flag_scope("monitor_port", -1):
+        eng = _engine(tiny_model)
+        url = server_mod.get_server().url
+        eng._watchdog_tripped = {"kind": "decode", "timeout_s": 0.1,
+                                 "dispatch": 7}
+        st, reason = _ready_reason(url)
+        assert st == 503 and reason["state"] == "watchdog-tripped"
+        assert reason["kind"] == "decode"
+        eng._watchdog_tripped = None
+        assert _ready_reason(url)[0] == 200
+        eng.shutdown()
+
+
+@pytest.mark.serve
+def test_zero_overhead_pin_no_port_no_plane(tiny_model):
+    """ISSUE 14 acceptance: FLAGS_monitor_port unset ⇒ a 50-request
+    serve run creates ZERO admin threads, no socket/server object, and
+    zero plane-owned registry series."""
+    assert server_mod.get_server() is None
+    with scoped_registry() as reg:
+        eng = _engine(tiny_model, max_batch_slots=3)
+        spec = LoadSpec(num_requests=50, rate_rps=500.0,
+                        prompt_len_range=(4, 8), max_new_range=(1, 2),
+                        vocab_size=256, seed=3)
+        summary = run_open_loop(eng, spec)
+        assert summary["requests_completed"] == 50
+        names = reg.names()
+    assert server_mod.get_server() is None
+    assert eng._admin is None
+    assert not any(t.name.startswith(server_mod.THREAD_PREFIX)
+                   for t in threading.enumerate())
+    # no plane-owned series: the run wrote only the serve_* telemetry
+    # it always writes
+    assert not [n for n in names if n.startswith("monitor_")]
+
+
+def test_collected_engine_is_pruned_not_ready(tiny_model):
+    """An engine dropped WITHOUT shutdown() must never linger as a
+    ready-reading registration: its weakref'd providers return the
+    STALE sentinel and the server prunes them on the next read — the
+    200 body's ``checks`` list shows no serving engine left."""
+    import gc
+    with flag_scope("monitor_port", -1):
+        eng = _engine(tiny_model)
+        srv = server_mod.get_server()
+        key = eng._admin_key
+        eng.cache.k = eng.cache.v = None     # drop device pools too
+        del eng
+        gc.collect()
+        st, doc = _get_json(srv.url, "/readyz")
+        assert st == 200
+        assert not [c for c in doc["checks"]
+                    if c.startswith("serving_engine")]
+        with srv._lock:                      # pruned, not just skipped
+            assert key not in srv._readiness
+        st, sdoc = _get_json(srv.url, "/statusz")
+        assert not [k for k in sdoc["sections"]
+                    if k.startswith("serving_engine")]
+        with srv._lock:
+            assert key not in srv._status
+
+
+def test_engine_shutdown_unregisters_providers(tiny_model):
+    with flag_scope("monitor_port", -1):
+        eng = _engine(tiny_model)
+        srv = server_mod.get_server()
+        key = eng._admin_key
+        with srv._lock:
+            assert key in srv._readiness and key in srv._status
+        eng.shutdown()
+        with srv._lock:
+            assert key not in srv._readiness and key not in srv._status
+
+
+# ---------------------------------------------------------------------------
+# monitor_top
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_top_renders_movement():
+    import monitor_top
+    reg = MetricsRegistry()
+    clock = ManualClock()
+    ring = TimeseriesRing(clock=clock)
+    reg.counter("serve_tokens_generated_total").inc(100)
+    reg.gauge("serve_queue_depth").set(4)
+    reg.gauge("slo_burn_rate").set(2.5, slo="serve_availability",
+                                   window="60s")
+    ring.ingest_rows(parse_prometheus(reg.to_prometheus()))
+    clock.advance(2.0)
+    reg.counter("serve_tokens_generated_total").inc(60)
+    ring.ingest_rows(parse_prometheus(reg.to_prometheus()))
+    frame = monitor_top.render_frame(ring, "http://h/metrics")
+    assert "tokens/s" in frame and "30.0" in frame   # 60 over 2s
+    assert "pressure" in frame and "queue" in frame
+    assert "SLO burn" in frame and "60s=2.50" in frame
+
+
+def test_monitor_top_against_live_server(admin, capsys):
+    import monitor_top
+    with scoped_registry() as reg:
+        reg.counter("serve_tokens_generated_total").inc(10)
+        rc = monitor_top.main(
+            ["--iterations", "2", "--interval", "0.05", "--no-clear",
+             admin.url])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "monitor_top" in out and "tokens/s" in out
+
+
+def test_monitor_top_survives_scrape_failure(capsys):
+    import monitor_top
+    rc = monitor_top.main(["--once", "--no-clear",
+                           "http://127.0.0.1:9/metrics"])
+    assert rc == 0
+    assert "scrape failed" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# monitor_report --slo
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_report_slo_renderer(tmp_path):
+    import monitor_report
+    from paddle_tpu.monitor.slo import SLOTracker
+    reg = MetricsRegistry()
+    clock = ManualClock()
+    t1 = SLOTracker("serve_availability", 0.99, windows=(60.0, 300.0),
+                    clock=clock)
+    t1.record(good=90, bad=10)
+    t1.publish(reg)
+    t2 = SLOTracker("serve_deadline", 0.95, windows=(60.0, 300.0),
+                    clock=clock)
+    t2.record(good=50)
+    t2.publish(reg)
+    p = str(tmp_path / "slo.jsonl")
+    reg.dump_jsonl(p)
+    out = monitor_report.render(
+        __import__("paddle_tpu.monitor", fromlist=["load_jsonl"])
+        .load_jsonl(p), slo=True)
+    assert "SLO error-budget burn" in out
+    assert "serve_availability" in out and "serve_deadline" in out
+    assert "BLOWN" in out                  # 10% errors vs 1% budget
+    assert "burn 60s" in out and "burn 300s" in out
+    # empty dump: helpful hint, not a crash
+    assert "no slo_* gauges" in monitor_report.render([], slo=True)

@@ -1,0 +1,112 @@
+"""Fold per-host registry JSONL dumps into ONE Prometheus exposition.
+
+The multi-host story of the telemetry plane (docs/OBSERVABILITY.md
+"Live telemetry plane"): every host of a multi-host run dumps its own
+registry JSONL (``MetricsRegistry.dump_jsonl`` — bench records, hapi
+``MonitorCallback`` streams, the recsys PS hosts). This tool rebuilds a
+registry per file (newest sample per ``(name, labels)``, the
+append-only contract) and merges them with
+``MetricsRegistry.merge`` semantics:
+
+- **counters** sum across hosts (and across restart segments of one
+  host — the merged series stays monotonic);
+- **gauges** gain a ``host=<label>`` label, so per-host values stay
+  distinguishable instead of last-writer-wins clobbering;
+- **histograms** merge bucket-wise; conflicting bucket boundaries are a
+  hard error (exit 1), never a silent mis-merge.
+
+The host label defaults to each file's basename stem; override per file
+with ``path=hostname``.
+
+Usage:
+    python tools/aggregate_metrics.py hostA.jsonl hostB.jsonl
+    python tools/aggregate_metrics.py run.jsonl=worker0 run2.jsonl=worker1 -o merged.prom
+    python tools/aggregate_metrics.py --no-host-label *.jsonl
+
+Output: the merged exposition text (stdout, or ``-o``), lint-clean per
+``paddle_tpu.monitor.metrics.lint_exposition``. Classic text/plain
+0.0.4 by default — safe for the node_exporter textfile collector and
+any plain parser; ``--openmetrics`` switches to the OpenMetrics form
+(histogram exemplars in the ``# {trace_id=...}`` suffix syntax +
+``# EOF`` trailer), which classic parsers reject.
+
+Exit code: 0 = merged, 1 = merge conflict (conflicting histogram
+buckets / kind clash), 2 = usage or read errors.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+_REPO_ROOT = __file__.rsplit("/", 2)[0]
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def aggregate(specs: List[str], host_labels: bool = True):
+    """Merge the given ``path[=host]`` specs into one fresh registry."""
+    from paddle_tpu.monitor.metrics import (MetricsRegistry,
+                                            load_registry_jsonl)
+    merged = MetricsRegistry()
+    for spec in specs:
+        path, _, host = spec.partition("=")
+        if not host:
+            host = os.path.splitext(os.path.basename(path))[0]
+        per_host = load_registry_jsonl(path)
+        merged.merge(per_host, host=host if host_labels else None)
+    return merged
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = None
+    for flag in ("-o", "--out"):
+        if flag in argv:
+            i = argv.index(flag)
+            try:
+                out_path = argv[i + 1]
+            except IndexError:
+                print(f"{flag} needs a path", file=sys.stderr)
+                return 2
+            del argv[i:i + 2]
+    host_labels = True
+    if "--no-host-label" in argv:
+        argv.remove("--no-host-label")
+        host_labels = False
+    openmetrics = "--openmetrics" in argv
+    if openmetrics:
+        argv.remove("--openmetrics")
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        merged = aggregate(argv, host_labels=host_labels)
+    except OSError as e:
+        print(f"cannot read input: {e}", file=sys.stderr)
+        return 2
+    except (ValueError, TypeError) as e:
+        print(f"MERGE CONFLICT: {e}", file=sys.stderr)
+        return 1
+    text = merged.to_prometheus(exemplars=openmetrics)
+    if openmetrics:
+        text += "# EOF\n"
+    from paddle_tpu.monitor.metrics import lint_exposition
+    problems = lint_exposition(text)
+    if problems:                      # should be unreachable: the
+        for p in problems:            # emitter escapes; a hit means an
+            print(f"LINT: {p}", file=sys.stderr)   # input poisoned us
+        return 1
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(f"wrote {out_path}: {len(merged.names())} metric(s) "
+              f"from {len(argv)} host file(s)")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,11 @@ stream — prints:
   counters, per-table HBM attribution and sharded-lookup fallbacks
   (``recsys_*`` series from paddle_tpu.recsys; docs/RECSYS.md;
   rendered next to --serve/--moe);
+- with ``--slo``: the error-budget burn table from the ``slo_*`` gauges
+  (monitor/slo.py) — per SLO the objective, period budget remaining and
+  burn rate per window (1.0 = spending exactly the budget; rendered
+  next to --serve, which tells you *what* is failing while this tells
+  you *how fast the budget goes*);
 - with ``--fallbacks``: every counted degradation in ONE table — scan
   loop-layout, Pallas-kernel XLA, pipeline sequential-GSPMD, MoE and
   recsys auto-path fallbacks with reason labels ("why is this run
@@ -62,7 +67,7 @@ tree with per-span duration, EXCLUSIVE time and the critical path
 (docs/OBSERVABILITY.md "Structured tracing").
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--comms] [--moe] [--recsys] [--fallbacks]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--slo] [--comms] [--moe] [--recsys] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
@@ -286,6 +291,60 @@ def _recsys_section(latest, used) -> List[str]:
         out.append("(no recsys_* gauges in this dump — run bench.py "
                    "--recsys or publish_tier_metrics() first)")
         out.append("")
+    return out
+
+
+def _slo_section(latest, used) -> List[str]:
+    """--slo: error-budget burn table from the ``slo_*`` gauges
+    (monitor/slo.py; PR 11 emits them, this mode renders them) — per
+    SLO the configured objective, the period budget remaining, and the
+    burn rate per configured window (1.0 = spending exactly the
+    budget; the SRE-workbook alert pairs fire around 6-14x). Rendered
+    next to --serve/--trace/--fallbacks."""
+    objective: Dict[str, float] = {}
+    remaining: Dict[str, float] = {}
+    burns: Dict[str, Dict[str, float]] = {}
+    for key, row in latest.items():
+        name, labels = key
+        d = dict(labels)
+        if name == "slo_objective":
+            used.add(key)
+            objective[str(d.get("slo", "-"))] = row.get("value", 0.0)
+        elif name == "slo_error_budget_remaining":
+            used.add(key)
+            remaining[str(d.get("slo", "-"))] = row.get("value", 0.0)
+        elif name == "slo_burn_rate":
+            used.add(key)
+            burns.setdefault(str(d.get("slo", "-")), {})[
+                str(d.get("window", "?"))] = row.get("value", 0.0)
+
+    def _window_key(w: str):
+        try:
+            return (0, float(w.rstrip("s")))
+        except ValueError:
+            return (1, 0.0)
+
+    windows = sorted({w for d in burns.values() for w in d},
+                     key=_window_key)
+    rows = []
+    for slo in sorted(set(objective) | set(remaining) | set(burns)):
+        b = burns.get(slo, {})
+        rem = remaining.get(slo)
+        rows.append(
+            [slo,
+             f"{objective.get(slo, 0.0):.4g}" if slo in objective
+             else "-",
+             (f"{rem:.3f}" + (" (BLOWN)" if rem < 0 else ""))
+             if rem is not None else "-"]
+            + [f"{b[w]:.2f}" if w in b else "-" for w in windows])
+    out = _table("SLO error-budget burn (1.0 = on budget)",
+                 ["slo", "objective", "budget left"]
+                 + [f"burn {w}" for w in windows], rows)
+    if not rows:
+        out = ["== SLO burn ==",
+               "(no slo_* gauges in this dump — arm "
+               "ServingConfig.slo_availability / slo_deadline, or call "
+               "SLOTracker.publish())", ""]
     return out
 
 
@@ -693,7 +752,7 @@ def render_traces(traces: List[dict], last: int = 10) -> str:
 def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
            moe: bool = False, fallbacks: bool = False,
-           recsys: bool = False) -> str:
+           recsys: bool = False, slo: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -701,6 +760,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
     # swallowed by the generic slowest-events table ----------------------
     serve_out: List[str] = (_serve_section(latest, used, raw_rows=rows)
                             if serve else [])
+    # -- SLO burn (--slo) renders next to --serve ------------------------
+    serve_out += _slo_section(latest, used) if slo else []
     # -- comm overlap (--comms) also claims its gauges early -------------
     comms_out: List[str] = (_comms_section(latest, used) if comms else [])
     # -- MoE router health (--moe) renders next to --comms ---------------
@@ -850,6 +911,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     recsys = "--recsys" in argv
     if recsys:
         argv.remove("--recsys")
+    slo = "--slo" in argv
+    if slo:
+        argv.remove("--slo")
     fallbacks = "--fallbacks" in argv
     if fallbacks:
         argv.remove("--fallbacks")
@@ -887,7 +951,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
-                 moe=moe, fallbacks=fallbacks, recsys=recsys), end="")
+                 moe=moe, fallbacks=fallbacks, recsys=recsys, slo=slo),
+          end="")
     return 0
 
 

@@ -1,0 +1,206 @@
+"""Live terminal view of a paddle_tpu telemetry endpoint — `top` for a
+serving/training process.
+
+Polls a live ``/metrics`` endpoint (the embedded admin server,
+``FLAGS_monitor_port``; docs/OBSERVABILITY.md "Live telemetry plane"),
+feeds every scrape into an in-memory
+``paddle_tpu.monitor.timeseries.TimeseriesRing``, and redraws one
+screen of MOVEMENT per interval — rates computed from consecutive
+scrapes, not the cumulative counters the raw page shows:
+
+- **throughput**: tokens/s, requests/s by lifecycle event, decode
+  dispatches/s and windowed mean decode latency;
+- **pressure**: queue depth, active slots, KV pages in use, overload
+  state;
+- **SLO burn**: ``slo_burn_rate{slo,window}`` gauges as-is (the burn IS
+  already a rate) + budget remaining;
+- **training**: steps/s and the ``train_step_mfu`` gauge when the
+  process publishes them.
+
+Curses-free by design: one ANSI home+clear escape per frame (disable
+with ``--no-clear`` for dumb terminals / piped output), so it runs over
+any ssh session. Everything is computed from the scrape text — the tool
+never imports jax and works against any process exposing the format.
+
+Usage:
+    python tools/monitor_top.py http://127.0.0.1:9090 [--interval 1.0]
+    python tools/monitor_top.py http://host:port/metrics --iterations 30
+    python tools/monitor_top.py --once http://127.0.0.1:9090
+
+Exit code: 0 (including Ctrl-C), 2 on usage errors. Scrape failures
+render as a banner and the loop keeps trying — a restarting server must
+not kill the operator's view.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+_REPO_ROOT = __file__.rsplit("/", 2)[0]
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: trailing window (seconds) for every rate shown
+RATE_WINDOW_S = 30.0
+
+_CLEAR = "\x1b[H\x1b[2J"
+
+
+def _fmt(v: Optional[float], fmt: str = "{:,.1f}",
+         none: str = "-") -> str:
+    return fmt.format(v) if v is not None else none
+
+
+def scrape(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def render_frame(ring, url: str, now: Optional[float] = None,
+                 error: Optional[str] = None) -> str:
+    """One screen of movement from the ring's history. Pure function of
+    the ring — tests drive it without any HTTP."""
+    W = RATE_WINDOW_S
+    lines: List[str] = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(now if now is not None else
+                                      time.time()))
+    lines.append(f"paddle_tpu monitor_top — {url} — {ts} "
+                 f"(rates over {W:g}s, {ring.snapshots_taken} scrapes)")
+    if error:
+        lines.append(f"!! scrape failed: {error}")
+    lines.append("")
+
+    # -- serving throughput -------------------------------------------------
+    tok_s = ring.rate("serve_tokens_generated_total", W)
+    dec_s = ring.rate("serve_decode_step_seconds_count", W)
+    dec_sum = ring.delta("serve_decode_step_seconds_sum", W)
+    dec_cnt = ring.delta("serve_decode_step_seconds_count", W)
+    dec_ms = (dec_sum / dec_cnt * 1e3
+              if dec_sum is not None and dec_cnt else None)
+    lines.append(f"serving   tokens/s {_fmt(tok_s):>10}   "
+                 f"decode/s {_fmt(dec_s):>8}   "
+                 f"decode mean {_fmt(dec_ms, '{:,.2f}')} ms")
+    ev_bits = []
+    for labels in ring.label_sets("serve_requests_total"):
+        r = ring.rate("serve_requests_total", W, **labels)
+        if r:
+            ev_bits.append(f"{labels.get('event', '?')} {r:,.2f}/s")
+    if ev_bits:
+        lines.append("requests  " + "   ".join(sorted(ev_bits)))
+
+    # -- pressure -----------------------------------------------------------
+    q = ring.latest("serve_queue_depth")
+    slots = ring.latest("serve_active_slots")
+    pages = ring.latest("serve_kv_pages_in_use")
+    over = ring.latest("serve_overload")
+    if any(v is not None for v in (q, slots, pages, over)):
+        state = ("OVERLOADED" if over else "normal") \
+            if over is not None else "-"
+        lines.append(f"pressure  queue {_fmt(q, '{:,.0f}'):>6}   "
+                     f"slots {_fmt(slots, '{:,.0f}'):>4}   "
+                     f"kv pages {_fmt(pages, '{:,.0f}'):>6}   "
+                     f"state {state}")
+
+    # -- SLO burn (already a rate: show the gauge) --------------------------
+    burn_rows = []
+    for labels in ring.label_sets("slo_burn_rate"):
+        v = ring.latest("slo_burn_rate", **labels)
+        if v is not None:
+            burn_rows.append((labels.get("slo", "?"),
+                              labels.get("window", "?"), v))
+    if burn_rows:
+        lines.append("")
+        lines.append("SLO burn  (1.0 = spending exactly the budget)")
+        by_slo = {}
+        for slo, window, v in sorted(burn_rows):
+            by_slo.setdefault(slo, []).append(f"{window}={v:,.2f}")
+        for slo, cells in sorted(by_slo.items()):
+            rem = ring.latest("slo_error_budget_remaining", slo=slo)
+            rem_s = f"   budget left {_fmt(rem, '{:,.3f}')}" \
+                if rem is not None else ""
+            lines.append(f"  {slo:<24} " + "  ".join(cells) + rem_s)
+
+    # -- training -----------------------------------------------------------
+    t_rows = []
+    for labels in ring.label_sets("train_step_steps_total"):
+        r = ring.rate("train_step_steps_total", W, **labels)
+        if r:
+            mfu = ring.latest("train_step_mfu", **labels)
+            t_rows.append(f"{labels.get('kind', '?')} "
+                          f"{r:,.2f} steps/s"
+                          + (f" mfu {mfu:.3f}" if mfu is not None
+                             else ""))
+    if t_rows:
+        lines.append("")
+        lines.append("training  " + "   ".join(sorted(t_rows)))
+
+    if ring.snapshots_taken < 2:
+        lines.append("")
+        lines.append("(rates need two scrapes — hold on...)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def fopt(flag: str, default: float) -> Optional[float]:
+        if flag not in argv:
+            return default
+        i = argv.index(flag)
+        try:
+            v = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print(f"{flag} needs a number", file=sys.stderr)
+            return None
+        del argv[i:i + 2]
+        return v
+
+    interval = fopt("--interval", 1.0)
+    iterations = fopt("--iterations", 0.0)
+    if interval is None or iterations is None:
+        return 2
+    once = "--once" in argv
+    if once:
+        argv.remove("--once")
+    no_clear = "--no-clear" in argv
+    if no_clear:
+        argv.remove("--no-clear")
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    url = argv[0]
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    if not url.endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+
+    from paddle_tpu.monitor.timeseries import (TimeseriesRing,
+                                               parse_prometheus)
+    ring = TimeseriesRing(capacity=max(
+        16, int(600 / max(interval, 0.1))))
+    n = 0
+    try:
+        while True:
+            err = None
+            try:
+                ring.ingest_rows(parse_prometheus(scrape(url)))
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                err = str(e)
+            frame = render_frame(ring, url, error=err)
+            sys.stdout.write(frame if no_clear else _CLEAR + frame)
+            sys.stdout.flush()
+            n += 1
+            if once or (iterations and n >= iterations):
+                return 0
+            time.sleep(max(interval, 0.05))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
