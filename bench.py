@@ -626,7 +626,9 @@ def bench_serve(quick: bool = False) -> list:
     log(f"serve[{name}]: /metrics endpoint overhead "
         f"{endpoint_overhead:.1f}% (tokens/s with a 1 Hz scraper "
         "attached vs without, same engine)")
-    return [
+    throughput_lines = serve_throughput_features(model, name, serve_cfg,
+                                                 quick=quick)
+    return throughput_lines + [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
                     vs_baseline=1.0,
@@ -652,6 +654,127 @@ def bench_serve(quick: bool = False) -> list:
         # "attach Prometheus to production" advice is fiction
         metric_line("serve_metrics_endpoint_overhead_pct",
                     endpoint_overhead, "overhead%", vs_baseline=1.0),
+    ]
+
+
+def serve_throughput_features(model, name, serve_cfg, quick: bool) -> list:
+    """ISSUE 15 legs: the chat-style shared-prefix workload under mmpp
+    bursty arrivals, served twice on the SAME seed — once with every
+    throughput feature off (the oracle) and once with the radix prefix
+    cache + chunked prefill + speculative decoding ON. Records
+    ``serve_prefix_hit_pct`` (hit%), ``serve_spec_accept_pct``
+    (accept%), ``serve_tokens_per_sec_chip`` and ``serve_ttft_p99_ms``
+    from the flags-ON run, and REFUSES to record unless the greedy
+    outputs of the two runs are token-identical (the acceptance
+    criterion is an oracle pin, not a vibe)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.serving import (LoadSpec, SamplingParams,
+                                    ServingEngine, run_open_loop)
+
+    if quick:
+        # chat shape: a dominant shared system prompt plus a short
+        # user tail — the regime the prefix cache exists for. One
+        # warm-cache request per prefix precedes the measured run
+        # (production caches are warm; a 8-request cold window would
+        # measure tree fill, not serving).
+        chat = LoadSpec(num_requests=10, rate_rps=20.0,
+                        prompt_len_range=(4, 12), max_new_range=(6, 12),
+                        vocab_size=model.cfg.vocab_size, seed=7,
+                        sampling=SamplingParams(), arrival="mmpp",
+                        burstiness=2.0, shared_prefix_len=32,
+                        prefix_pool_size=2, prefix_zipf=1.2)
+        chunk = 16
+    else:
+        chat = LoadSpec(num_requests=24, rate_rps=4.0,
+                        prompt_len_range=(16, 64),
+                        max_new_range=(16, 48),
+                        vocab_size=model.cfg.vocab_size, seed=7,
+                        sampling=SamplingParams(), arrival="mmpp",
+                        burstiness=2.0, shared_prefix_len=256,
+                        prefix_pool_size=4, prefix_zipf=1.2)
+        chunk = 128
+    # parity prompts: a shared-prefix pair plus a self-repetitive tail
+    # (the regime speculation accelerates) — run through BOTH engines
+    rng = np.random.default_rng(11)
+    pre = rng.integers(0, model.cfg.vocab_size, (32,)).tolist()
+    parity_prompts = [pre + rng.integers(0, model.cfg.vocab_size,
+                                         (8,)).tolist(),
+                      pre + rng.integers(0, model.cfg.vocab_size,
+                                         (5,)).tolist(),
+                      [3, 4, 5, 3, 4, 5, 3, 4]]
+
+    def phase(flags_on: bool):
+        import contextlib
+        ctx = []
+        if flags_on:
+            ctx = [flag_scope("serve_prefix_cache", True),
+                   flag_scope("serve_prefill_chunk", chunk),
+                   flag_scope("serve_spec_k", 4)]
+        with contextlib.ExitStack() as stack:
+            for c in ctx:
+                stack.enter_context(c)
+            eng = ServingEngine(model, dataclasses.replace(serve_cfg))
+            eng.warmup()
+        outs = [o[-8:].tolist() for o in eng.generate(
+            parity_prompts, max_new_tokens=8)]
+        # warm the prefix tree the way production is warm: a short
+        # burst of the SAME-seed workload (the pool prefixes derive
+        # from the seed, so a different seed would warm the WRONG
+        # prefixes) before the measured window; the flags-OFF engine
+        # runs the same warm requests, so both phases measure
+        # identical offered work on a steady-state engine
+        run_open_loop(eng, dataclasses.replace(
+            chat, num_requests=chat.prefix_pool_size, rate_rps=1e6))
+        # measured window: deltas around the chat run, not the
+        # engine-cumulative summary (which spans the warm phases)
+        tok0 = eng._stats["tokens_generated"]
+        n_ttft0 = len(eng._lat["ttft"])
+        t0 = time.perf_counter()
+        summary = run_open_loop(eng, chat)
+        wall = max(time.perf_counter() - t0, 1e-9)
+        tps = (eng._stats["tokens_generated"] - tok0) / wall
+        ttft = eng._lat["ttft"][n_ttft0:]
+        ttft99 = (float(np.percentile(np.asarray(ttft), 99)) * 1e3
+                  if ttft else 0.0)
+        eng.shutdown()
+        return summary, outs, tps, ttft99
+
+    s_off, outs_off, tps_off, ttft99_off = phase(False)
+    s_on, outs_on, tps_on, ttft99_on = phase(True)
+    if outs_on != outs_off:
+        log("serve[chat]: PARITY FAILURE — greedy outputs with the "
+            "throughput features ON diverge from the flags-off oracle; "
+            "refusing to record the feature legs")
+        log(f"  off: {outs_off}\n  on:  {outs_on}")
+        return []
+    hit = s_on["prefix_hit_pct"] or 0.0
+    accept = s_on["spec_accept_pct"] or 0.0
+    n_chips = max(1, jax.device_count())
+    log(f"serve[chat/{name}]: mmpp shared-prefix workload, features "
+        f"ON vs OFF on seed {chat.seed}: tokens/s {tps_off:.1f} -> "
+        f"{tps_on:.1f} ({(tps_on / max(tps_off, 1e-9) - 1) * 100:+.1f}%), "
+        f"ttft p99 {ttft99_off:.1f} -> {ttft99_on:.1f} ms; prefix hit "
+        f"{hit:.1f}% ({s_on['prefix_hit_tokens']} tokens), spec accept "
+        f"{accept:.1f}% ({s_on['spec_accepted']}/{s_on['spec_proposed']}"
+        f", {s_on['spec_rolled_back']} rolled back), "
+        f"{s_on['prefill_chunks']} chunks, greedy outputs token-"
+        "identical to the oracle")
+    return [
+        metric_line("serve_prefix_hit_pct", hit, "hit%",
+                    vs_baseline=1.0),
+        metric_line("serve_spec_accept_pct", accept, "accept%",
+                    vs_baseline=1.0,
+                    proposed=s_on["spec_proposed"]),
+        metric_line("serve_tokens_per_sec_chip", tps_on / n_chips,
+                    "tokens/s", vs_baseline=1.0,
+                    vs_flags_off=round(tps_on / max(tps_off, 1e-9), 3)),
+        metric_line("serve_ttft_p99_ms", ttft99_on, "ms",
+                    vs_baseline=1.0,
+                    vs_flags_off_ms=round(ttft99_off, 1)),
     ]
 
 

@@ -222,6 +222,43 @@ define_flag("serve_watchdog_s", 0.0,
             "overhead. The budget covers a whole dispatch including a "
             "cold compile; warmup() first, or set it well above "
             "cold-start time. Modeled on FLAGS_collective_timeout_s.")
+define_flag("serve_prefix_cache", False,
+            "Radix-tree prefix cache over the serving KV page pools "
+            "(paddle_tpu.serving.prefix_cache): completed/evicted "
+            "requests donate their full pages into a token-keyed radix "
+            "tree with per-page refcounts; admission walks the tree and "
+            "maps shared pages copy-on-write into the new slot's block "
+            "table, so chat traffic with shared system prompts skips "
+            "the redundant prefix prefill (vLLM PagedAttention / SGLang "
+            "RadixAttention). Off (default) = the pre-cache path, "
+            "bit-compatible — every admission prefills from token 0. "
+            "Read at ServingEngine construction.")
+define_flag("serve_prefill_chunk", 0,
+            "Chunked prefill (paddle_tpu.serving.engine): > 0 = long "
+            "prompts prefill in chunks of at most this many tokens, one "
+            "chunk per engine iteration interleaved with the decode "
+            "dispatches, so a long admission no longer stalls running "
+            "decodes for its whole prompt (the TTFT-spike killer under "
+            "bursty load). Later chunks attend over the pages earlier "
+            "chunks wrote (the context-prefill program). 0 (default) = "
+            "one-shot prefill, bit-compatible with the pre-chunking "
+            "path. Read at ServingEngine construction.")
+define_flag("serve_spec_k", 0,
+            "Speculative decoding draft length (paddle_tpu.serving."
+            "spec_decode): > 0 = an n-gram/prompt-lookup drafter (no "
+            "second model) proposes up to k tokens per slot and ONE "
+            "batched verify dispatch scores all k+1 positions against "
+            "the paged cache; the accepted prefix plus one bonus token "
+            "commit, rejected tails roll back by block-table truncation. "
+            "Greedy output is token-identical to the non-speculative "
+            "path (pinned); sampled slots fall back to single-token "
+            "decode rows. 0 (default) = one decode dispatch per token, "
+            "bit-compatible. Read at ServingEngine construction.")
+define_flag("serve_spec_ngram", 3,
+            "Longest suffix n-gram the speculative drafter matches "
+            "against the request's own prompt+generated history "
+            "(prompt-lookup decoding); it backs off to shorter n-grams "
+            "down to 1 before giving up on a slot for the iteration.")
 define_flag("pallas_ce", True,
             "Serve the streamed (chunked) hard-label cross-entropy with "
             "the fused Pallas kernel (ops.pallas.chunked_ce): online f32 "

@@ -258,12 +258,38 @@ class GPTAttention(Layer):
                    name="paged_kv_write")
         vp = apply(upd, cache.v_pages, v, cache.block_table, pos,
                    name="paged_kv_write")
-        new_cache = PagedLayerCache(kp, vp, cache.block_table)
+        from ..serving.kv_cache import ContextPagedLayerCache
+        is_ctx = isinstance(cache, ContextPagedLayerCache)
+        new_cache = type(cache)(kp, vp, cache.block_table)
         S = x.shape[1]
-        if S > 1:
+        if S > 1 and not is_ctx:
             from ..ops.attention import scaled_dot_product_attention
             out = scaled_dot_product_attention(
                 q, k, v, dropout_p=0.0, is_causal=True, training=False)
+            return out, new_cache
+        if S > 1:
+            # CONTEXT prefill (ISSUE 15): the chunk starts at pos > 0 —
+            # a chunked-prefill continuation, a prefix-cache-hit tail or
+            # a speculative verify window — so row i must see every
+            # page-resident position <= pos + i, not just its own
+            # chunk. Same gather + additive-mask construction as the
+            # S == 1 decode fallback, one row of mask per chunk row.
+            def attend_ctx(q_, kpages, vpages, table, p):
+                from ..ops.attention import sdpa_array
+                from ..serving.kv_cache import gather_pages as _gp
+                gk = _gp(kpages, table)
+                gv = _gp(vpages, table)
+                cols = jnp.arange(gk.shape[1], dtype=jnp.int32)
+                rows = (p[:, None].astype(jnp.int32)
+                        + jnp.arange(S, dtype=jnp.int32)[None, :])
+                mask = jnp.where(
+                    cols[None, None, :] <= rows[:, :, None],
+                    0.0, -1e30)[:, None]          # [B, 1, S, MB*bs]
+                return sdpa_array(q_, gk, gv, mask=mask, dropout_p=0.0,
+                                  is_causal=False)
+
+            out = apply(attend_ctx, q, kp, vp, cache.block_table, pos,
+                        name="paged_context_attention")
             return out, new_cache
 
         # decode kernel dispatch resolved OUTSIDE the traced fn so the
@@ -400,6 +426,20 @@ def _paged_scan_body(template, x, cache_slices, extras):
     block_table, pos = extras
     x, c = template(x, PagedLayerCache(k_pages, v_pages, block_table),
                     pos=pos)
+    return x, (c.k_pages, c.v_pages)
+
+
+def _paged_scan_body_ctx(template, x, cache_slices, extras):
+    """Context-prefill twin of :func:`_paged_scan_body` (ISSUE 15): the
+    layer cache is the :class:`ContextPagedLayerCache` marker, so S>1
+    chunks attend over prior pages. A distinct module-level function —
+    its identity keys the scan cache token, so the two attention paths
+    can never share a trace."""
+    from ..serving.kv_cache import ContextPagedLayerCache
+    k_pages, v_pages = cache_slices
+    block_table, pos = extras
+    x, c = template(x, ContextPagedLayerCache(k_pages, v_pages,
+                                              block_table), pos=pos)
     return x, (c.k_pages, c.v_pages)
 
 
@@ -591,13 +631,22 @@ class GPTModel(Layer):
         (kill switch / heterogeneous stacks) computes the same math per
         layer."""
         from ..core.flags import get_flag
-        from ..serving.kv_cache import PagedCacheView, PagedLayerCache
+        from ..serving.kv_cache import (ContextPagedCacheView,
+                                        ContextPagedLayerCache,
+                                        PagedCacheView, PagedLayerCache)
+        # the view CLASS carries the attention-path choice: a
+        # ContextPagedCacheView (chunked prefill / prefix-hit tails /
+        # speculative verify) selects the gather-over-prior-pages S>1
+        # path at trace time (ISSUE 15)
+        is_ctx = isinstance(caches, ContextPagedCacheView)
+        layer_cls = ContextPagedLayerCache if is_ctx else PagedLayerCache
+        body = _paged_scan_body_ctx if is_ctx else _paged_scan_body
         eligible = self.cfg.scan_layers and can_scan_layers(self.layers)
         if eligible and get_flag("scan_decode"):
             x, (new_k, new_v) = scan_layers_with_cache(
                 self.layers, x, (caches.k, caches.v),
                 caches.block_table, cache_pos,
-                body_call=_paged_scan_body, name="gpt_paged_scan")
+                body_call=body, name="gpt_paged_scan")
             x = self.final_norm(x)
             return x, PagedCacheView(new_k, new_v, caches.block_table)
         if eligible:
@@ -605,8 +654,8 @@ class GPTModel(Layer):
         from ..tensor.manipulation import stack as tstack
         ks, vs = [], []
         for i, blk in enumerate(self.layers):
-            layer_cache = PagedLayerCache(caches.k[i], caches.v[i],
-                                          caches.block_table)
+            layer_cache = layer_cls(caches.k[i], caches.v[i],
+                                    caches.block_table)
             x, c = blk(x, layer_cache, pos=cache_pos)
             ks.append(c.k_pages)
             vs.append(c.v_pages)

@@ -26,8 +26,11 @@ matrix.
 
 from .detok import StreamingDetokenizer  # noqa: F401
 from .engine import ServingConfig, ServingEngine  # noqa: F401
-from .kv_cache import (BlockAllocator, PagedCacheView,  # noqa: F401
+from .kv_cache import (BlockAllocator, ContextPagedCacheView,  # noqa: F401
+                       ContextPagedLayerCache, PagedCacheView,
                        PagedKVCache, PagedLayerCache)
+from .prefix_cache import RadixPrefixCache  # noqa: F401
+from .spec_decode import propose_ngram  # noqa: F401
 from .loadgen import (LoadSpec, TokenBucket, build_requests,  # noqa: F401
                       run_open_loop)
 from .resilience import (DecodeWatchdogError, DrainLatch,  # noqa: F401
@@ -47,6 +50,8 @@ __all__ = [
     "DrainLatch", "DrainReport", "OverloadDetector",
     "save_drain_snapshot", "load_drain_snapshot",
     "requests_from_snapshot", "TERMINAL_OUTCOMES", "reset",
+    "RadixPrefixCache", "propose_ngram", "ContextPagedCacheView",
+    "ContextPagedLayerCache",
 ]
 
 

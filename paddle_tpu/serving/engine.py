@@ -50,7 +50,8 @@ from ..monitor import flight_recorder as _flight
 from ..monitor import trace as _trace
 from ..testing import chaos
 from .detok import StreamingDetokenizer
-from .kv_cache import PagedCacheView, PagedKVCache, blocks_needed
+from .kv_cache import (ContextPagedCacheView, PagedCacheView,
+                       PagedKVCache, blocks_needed)
 from .resilience import (DecodeWatchdogError, DispatchWorker, DrainLatch,
                          DrainReport, EngineDrained, OverloadDetector,
                          ServerOverloaded, request_spec,
@@ -193,6 +194,21 @@ class ServingEngine:
             "serve_deadline", c.slo_deadline,
             windows=c.slo_windows, clock=clock)
             if c.slo_deadline > 0 else None)
+        # throughput features (ISSUE 15), each behind its own
+        # kill-switch flag with the flags-off path bit-compatible; read
+        # ONCE here so an engine's behavior (and its compiled program
+        # set) is stable for its lifetime — tests flip them with
+        # flag_scope around construction
+        from ..core.flags import get_flag
+        self._chunk = int(get_flag("serve_prefill_chunk") or 0)
+        self._spec_k = int(get_flag("serve_spec_k") or 0)
+        self._spec_ngram = max(1, int(get_flag("serve_spec_ngram") or 1))
+        self.prefix_cache = None
+        if bool(get_flag("serve_prefix_cache")):
+            from .prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(self.cache)
+            self.cache.prefix_cache = self.prefix_cache
+        self._prefix_published: Dict[str, float] = {}
         self._drain_latch: Optional[DrainLatch] = None
         self._draining = False
         self._drained = False
@@ -204,7 +220,10 @@ class ServingEngine:
         self._dispatch_seq = 0
         self._stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
                        "decode_slot_steps": 0, "decode_batch_max": 0,
-                       "tokens_generated": 0, "program_compiles": 0}
+                       "tokens_generated": 0, "program_compiles": 0,
+                       "prefill_chunks": 0, "prefill_tokens": 0,
+                       "verify_dispatches": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "spec_rolled_back": 0}
         self._lat: Dict[str, List[float]] = {
             "ttft": [], "tpot": [], "e2e": [], "decode_step": []}
         self._t_first_work: Optional[float] = None
@@ -303,10 +322,15 @@ class ServingEngine:
         self._dispatch_seq += 1
         return jax.random.fold_in(self._key, self._dispatch_seq)
 
-    def _fwd(self, params, ids, k, v, table, pos):
+    def _fwd(self, params, ids, k, v, table, pos, ctx: bool = False):
         """Pure model forward over the paged view (traced inside the
-        prefill/decode programs)."""
-        view = PagedCacheView(Tensor(k), Tensor(v), Tensor(table))
+        prefill/decode programs). ``ctx=True`` selects the
+        CONTEXT-prefill attention path (ISSUE 15): S>1 chunks attend
+        over everything already in the pages, not just themselves —
+        chunked-prefill continuations, prefix-hit tails and speculative
+        verify windows all run through it."""
+        cls = ContextPagedCacheView if ctx else PagedCacheView
+        view = cls(Tensor(k), Tensor(v), Tensor(table))
         with bind(self.model, params, dict(self.buffers)), no_grad(), \
                 trace_rng(jax.random.key(0)):
             logits, new = self.model(Tensor(ids), caches=view,
@@ -427,16 +451,107 @@ class ServingEngine:
         self._programs[key] = prog
         return prog
 
+    def _get_prefill_ctx(self, nb: int, sp: int) -> AOTProgram:
+        """Context-prefill program (ISSUE 15): same shape contract as
+        the plain prefill bucket, plus a per-row ``pos`` argument — the
+        chunk's rows occupy positions ``pos .. pos+lens-1`` and attend
+        over every page-resident position before them. Serves chunked-
+        prefill continuation chunks and prefix-cache-hit tails."""
+        key = ("prefill_ctx", nb, sp)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        def prefill_ctx_fn(params, k, v, table, ids, lens, pos, rng,
+                           temps, top_ks, top_ps, poison):
+            logits, k, v = self._fwd(params, ids, k, v, table, pos,
+                                     ctx=True)
+            last = jnp.take_along_axis(
+                logits, (lens - 1).astype(jnp.int32)[:, None, None],
+                axis=1)[:, 0, :]
+            row = last + poison[:, None]
+            ok = jnp.isfinite(row).all(axis=-1)
+            toks = sample_tokens(row, rng, temps, top_ks, top_ps)
+            return toks, ok, k, v
+
+        mb = self.cache.max_blocks_per_slot
+        prog = AOTProgram(f"serve_prefill_ctx_b{nb}_s{sp}",
+                          prefill_ctx_fn,
+                          donate_argnums=self._donate(),
+                          on_attribute=self._attribute)
+        prog.compile((self.params, self.cache.k, self.cache.v,
+                      jnp.zeros((nb, mb), jnp.int32),
+                      jnp.zeros((nb, sp), jnp.int32),
+                      jnp.ones((nb,), jnp.int32),
+                      jnp.zeros((nb,), jnp.int32), self._key,
+                      jnp.ones((nb,), jnp.float32),
+                      jnp.zeros((nb,), jnp.int32),
+                      jnp.ones((nb,), jnp.float32),
+                      jnp.zeros((nb,), jnp.float32)))
+        self._programs[key] = prog
+        return prog
+
+    def _get_verify(self) -> AOTProgram:
+        """Speculative-verify program (ISSUE 15): ONE dispatch scores
+        all ``k+1`` positions of ``[last_token, d_1 .. d_k]`` per slot
+        against the paged cache. Returns the row-0 token under each
+        slot's sampling params (== the plain decode output), per-row
+        greedy argmaxes for draft acceptance, and per-row finite flags
+        (fault isolation stays per-slot AND per-used-row — pad rows
+        beyond a slot's draft may read scratch garbage and are never
+        consulted)."""
+        key = ("verify", self._spec_k + 1)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        S = self._spec_k + 1
+
+        def verify_fn(params, k, v, table, pos, ids, active, rng,
+                      temps, top_ks, top_ps, poison):
+            logits, k, v = self._fwd(params, ids, k, v, table, pos,
+                                     ctx=True)                # [B,S,V]
+            row0 = logits[:, 0, :] + poison[:, None]
+            ok_rows = jnp.isfinite(logits).all(axis=-1)       # [B,S]
+            ok_rows = ok_rows.at[:, 0].set(
+                jnp.isfinite(row0).all(axis=-1))
+            tok0 = sample_tokens(row0, rng, temps, top_ks, top_ps)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jnp.where(active, tok0, 0), greedy, ok_rows, k, v
+
+        B = self.config.max_batch_slots
+        mb = self.cache.max_blocks_per_slot
+        prog = AOTProgram(f"serve_verify_s{S}", verify_fn,
+                          donate_argnums=self._donate(),
+                          on_attribute=self._attribute)
+        prog.compile((self.params, self.cache.k, self.cache.v,
+                      jnp.zeros((B, mb), jnp.int32),
+                      jnp.zeros((B,), jnp.int32),
+                      jnp.zeros((B, S), jnp.int32),
+                      jnp.zeros((B,), bool), self._key,
+                      jnp.ones((B,), jnp.float32),
+                      jnp.zeros((B,), jnp.int32),
+                      jnp.ones((B,), jnp.float32),
+                      jnp.zeros((B,), jnp.float32)))
+        self._programs[key] = prog
+        return prog
+
     def warmup(self, prefill_signatures: Optional[Sequence[Tuple[int, int]]]
                = None) -> int:
         """AOT-compile the decode program and the given (or full bucket
-        table's) prefill signatures before traffic arrives. Returns the
+        table's) prefill signatures before traffic arrives — plus, when
+        the ISSUE 15 features are armed, the context-prefill twins and
+        the speculative-verify program, so the first prefix hit / chunk
+        continuation / draft never pays a cold compile. Returns the
         number of programs now resident."""
         self._get_decode()
         for nb, sp in (prefill_signatures
                        if prefill_signatures is not None
                        else self.buckets.signatures()):
             self._get_prefill(nb, sp)
+            if self._chunk > 0 or self.prefix_cache is not None:
+                self._get_prefill_ctx(nb, sp)
+        if self._spec_k > 0:
+            self._get_verify()
         return len(self._programs)
 
     #: raw latency samples kept per series for exact percentiles; beyond
@@ -709,33 +824,54 @@ class ServingEngine:
             if transition is not None:
                 self._overload_transition(transition)
         if admit:
-            groups = sched.plan_admissions()
-            for gi, group in enumerate(groups):
-                try:
-                    self._run_prefill(group)
-                except DecodeWatchdogError:
-                    # every not-yet-prefilled state of this plan — the
-                    # tripped group AND any planned after it — holds a
-                    # slot but produced no token; un-admit them all in
-                    # one batch (admission order restored: groups are
-                    # bucketed by length, not arrival) or the retried
-                    # step() would decode slots with nothing to feed
-                    pending = [st for g in groups[gi:] for st in g.states]
-                    pending.sort(key=lambda st: (st.admitted_t,
-                                                 st.request.request_id))
-                    sched.rollback_admission(pending)
-                    for st in pending:
-                        self._trace_requeue(st, "watchdog_rollback")
-                    raise
-        if sched.active():
+            sched.plan_admissions()
+        # ONE prefill pass per iteration over every prefilling slot —
+        # newly admitted ones AND chunked prefills carried from earlier
+        # iterations (they advance even under admit=False: a draining
+        # engine must finish admitted work). With chunking off and no
+        # prefix cache this reproduces the pre-ISSUE-15 groups exactly.
+        groups = self._plan_prefill_groups()
+        for gi, group in enumerate(groups):
+            try:
+                self._run_prefill(group)
+            except DecodeWatchdogError:
+                # every not-yet-prefilled state of this plan — the
+                # tripped group AND any planned after it — holds a
+                # slot but produced no token; un-admit them all in
+                # one batch (admission order restored: groups are
+                # bucketed by length, not arrival) or the retried
+                # step() would decode slots with nothing to feed.
+                # A mid-chunk state loses its chunk progress and
+                # re-prefills from the queue — token-exact.
+                pending = [st for g in groups[gi:] for st in g.states]
+                pending.sort(key=lambda st: (st.admitted_t,
+                                             st.request.request_id))
+                sched.rollback_admission(pending)
+                for st in pending:
+                    self._trace_requeue(st, "watchdog_rollback")
+                raise
+        if self._decodable():
+            if self._spec_k > 0:
+                # drafts staged BEFORE the capacity pass so the verify
+                # window's K/V writes land in real pages, never scratch
+                self._stage_drafts()
             for st in sched.ensure_decode_capacity():
                 # recompute-preemption: back to the queue with the SAME
                 # trace — the span tree shows the second residency
                 self._trace_requeue(st, "preemption")
-            if sched.active():
-                self._run_decode()
+            if self._decodable():
+                if any(st.draft for _, st in sched.active()):
+                    self._run_verify()
+                else:
+                    self._run_decode()
         self._publish_gauges()
         return sched.has_work
+
+    def _decodable(self) -> List[Tuple[int, RequestState]]:
+        """Active slots that take a decode/verify row this iteration —
+        chunked prefills still mid-prompt do not."""
+        return [(slot, st) for slot, st in self.scheduler.active()
+                if not st.prefilling]
 
     def _trace_requeue(self, st: RequestState, reason: str) -> None:
         """A request lost its slot but lives on (recompute-preemption,
@@ -864,12 +1000,47 @@ class ServingEngine:
             temps[i], tks[i], tps[i] = s.temperature, s.top_k, s.top_p
         return jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps)
 
+    def _plan_prefill_groups(self) -> List[AdmissionGroup]:
+        """Group every prefilling slot's NEXT chunk into bucketed
+        dispatches. Chunking off + no prefix cache ⇒ every prefilling
+        state is freshly admitted with its whole effective prompt as
+        the one chunk — the exact pre-ISSUE-15 grouping (same buckets,
+        same dispatch count, byte-identical traffic). Chunk length is
+        ``min(FLAGS_serve_prefill_chunk, remaining)``; groups are keyed
+        by (needs-context, length bucket) because a chunk at pos > 0
+        must run the context program while pos == 0 chunks keep the
+        bit-compatible plain one."""
+        by_key: Dict[Tuple[bool, int], List[RequestState]] = {}
+        for _, st in self.scheduler.active():
+            if not st.prefilling:
+                continue
+            remaining = st.prefill_len - st.prefill_pos
+            clen = min(self._chunk, remaining) if self._chunk > 0 \
+                else remaining
+            key = (st.prefill_pos > 0, self.buckets.len_bucket(clen))
+            by_key.setdefault(key, []).append(st)
+        groups: List[AdmissionGroup] = []
+        for ctx, lb in sorted(by_key):
+            sts = sorted(by_key[(ctx, lb)],
+                         key=lambda s: (s.admitted_t,
+                                        s.request.request_id))
+            mb = self.buckets.max_batch
+            for i in range(0, len(sts), mb):
+                chunk = sts[i:i + mb]
+                groups.append(AdmissionGroup(
+                    lb, self.buckets.batch_bucket(len(chunk)), chunk))
+        return groups
+
     def _run_prefill(self, group: AdmissionGroup) -> None:
         nb, sp = group.batch_bucket, group.len_bucket
         states: List[Optional[RequestState]] = list(group.states)
         states += [None] * (nb - len(states))
         ids = np.zeros((nb, sp), np.int32)
         lens = np.ones((nb,), np.int32)
+        pos = np.zeros((nb,), np.int32)
+        ctx = any(st is not None and st.prefill_pos > 0
+                  for st in states)
+        chunked = False
         # padded rows map to None -> an all-scratch table row (their
         # K/V writes must never land in a live slot's pages)
         rows: List[Optional[int]] = [None] * nb
@@ -877,39 +1048,65 @@ class ServingEngine:
             if st is None:
                 continue
             eff = st.effective_prompt()
-            ids[i, :eff.size] = eff
-            lens[i] = eff.size
+            remaining = st.prefill_len - st.prefill_pos
+            clen = min(self._chunk, remaining) if self._chunk > 0 \
+                else remaining
+            chunked = chunked or clen < remaining
+            # COW contract: writes start at prefill_pos, which is never
+            # below the shared-prefix coverage — a shared page is
+            # read-only for this slot by construction
+            assert st.prefill_pos >= (
+                self.cache.slot_shared_blocks(st.slot)
+                * self.cache.block_size)
+            ids[i, :clen] = eff[st.prefill_pos:st.prefill_pos + clen]
+            lens[i] = clen
+            pos[i] = st.prefill_pos
             rows[i] = st.slot
         t0 = self.clock()
         if self._t_first_work is None:
             self._t_first_work = t0
         for st in group.states:
             tr = st.trace
-            if tr is not None:
+            if tr is not None and "admitted" not in st.trace_spans:
                 # queued ends / admitted opens at the scheduler's
                 # admission stamp, not dispatch time — queueing delay
-                # and prefill wait attribute to the right spans
+                # and prefill wait attribute to the right spans (a
+                # chunked prefill opens them at its FIRST chunk only)
                 qs = st.trace_spans.pop("queued", None)
                 if qs is not None:
                     tr.end_span(qs, t=st.admitted_t)
                 st.trace_spans["admitted"] = tr.start_span(
-                    "admitted", t=st.admitted_t, slot=st.slot)
-        prog = self._get_prefill(nb, sp)
+                    "admitted", t=st.admitted_t, slot=st.slot,
+                    prefix_hit_tokens=st.prefill_pos)
+        if ctx:
+            prog = self._get_prefill_ctx(nb, sp)
+            args = (self.params, self.cache.k, self.cache.v,
+                    self.cache.table_array(rows), jnp.asarray(ids),
+                    jnp.asarray(lens), jnp.asarray(pos),
+                    self._next_key())
+        else:
+            prog = self._get_prefill(nb, sp)
+            args = (self.params, self.cache.k, self.cache.v,
+                    self.cache.table_array(rows), jnp.asarray(ids),
+                    jnp.asarray(lens), self._next_key())
         temps, tks, tps = self._sampling_arrays(states)
         # a DecodeWatchdogError here propagates to step(), which rolls
         # back every not-yet-prefilled state of the plan (token-exact:
         # the tripped dispatch's pool writes died with its thread)
         toks, ok, new_k, new_v = self._guarded_dispatch(
             "prefill", prog,
-            (self.params, self.cache.k, self.cache.v,
-             self.cache.table_array(rows), jnp.asarray(ids),
-             jnp.asarray(lens), self._next_key(), temps, tks, tps,
-             self._poison_array(states)))
+            args + (temps, tks, tps, self._poison_array(states)))
         self.cache.update(new_k, new_v)
         toks = np.asarray(toks)
         ok = np.asarray(ok)
         now = self.clock()
         self._stats["prefill_dispatches"] += 1
+        if chunked or self._chunk > 0:
+            self._stats["prefill_chunks"] += len(group.states)
+            get_registry().counter(
+                "serve_prefill_chunks_total",
+                "chunked-prefill chunk rows dispatched"
+            ).inc(len(group.states))
         reg = get_registry()
         reg.histogram("serve_prefill_seconds",
                       "prefill dispatch wall time").observe(
@@ -917,15 +1114,21 @@ class ServingEngine:
         for i, st in enumerate(states):
             if st is None:
                 continue
+            clen = int(lens[i])
+            st.prefill_pos += clen
+            self._stats["prefill_tokens"] += clen
+            final = st.prefill_pos >= st.prefill_len
             tr = st.trace
             if tr is not None:
                 tr.end_span(tr.start_span(
                     "prefill", parent=st.trace_spans.get("admitted"),
-                    t=t0, bucket=f"b{nb}_s{sp}"), t=now)
+                    t=t0, bucket=f"b{nb}_s{sp}", pos=int(pos[i]),
+                    tokens=clen), t=now)
             if not ok[i]:
                 self.scheduler.fail(st, "non-finite logits at prefill")
                 continue
-            self._accept_token(st, int(toks[i]), now)
+            if final:
+                self._accept_token(st, int(toks[i]), now)
 
     def _poison_array(self, states: Sequence[Optional[RequestState]]):
         """[n] f32 additive logits poison: all zeros (bit-transparent)
@@ -936,13 +1139,152 @@ class ServingEngine:
                 poison[i] = np.nan
         return jnp.asarray(poison)
 
+    def _decode_table(self, per_slot: Sequence[Optional[RequestState]]):
+        """Block-table argument for a decode/verify dispatch: only the
+        DECODABLE slots' real rows; every other row — inactive slots
+        AND mid-chunk prefilling slots, which hold live (possibly
+        COW-shared) pages but take no decode row — is all-scratch, so
+        the dispatch's unconditional per-row K/V scatter (pos 0, token
+        0 for masked rows) can never land in a resident page. Without
+        chunked prefill every resident slot is decodable and this is
+        exactly ``table_array()`` (bit-identical args)."""
+        return self.cache.table_array(
+            [st.slot if st is not None else None for st in per_slot])
+
+    def _stage_drafts(self) -> None:
+        """Prompt-lookup drafting (ISSUE 15): propose up to ``k`` draft
+        tokens per GREEDY decodable slot from its own history. Zero
+        drafts everywhere ⇒ the iteration falls through to the plain
+        decode program — the drafter costs nothing when traffic has no
+        self-repetition."""
+        from .spec_decode import propose_ngram
+        proposed = 0
+        for _, st in self._decodable():
+            st.draft = []
+            budget = min(self._spec_k, st.remaining_new_tokens() - 1)
+            if budget <= 0 or st.request.sampling.temperature > 0:
+                continue            # sampled slots decode via row 0
+            hist = np.concatenate([
+                st.request.prompt,
+                np.asarray(st.generated, np.int32)])
+            st.draft = [int(t) for t in propose_ngram(
+                hist, budget, max_ngram=self._spec_ngram)]
+            proposed += len(st.draft)
+        if proposed:
+            self._stats["spec_proposed"] += proposed
+            get_registry().counter(
+                "serve_spec_proposed_total",
+                "speculative draft tokens proposed").inc(proposed)
+
+    def _run_verify(self) -> None:
+        """ONE batched verify dispatch over all decodable slots: row 0
+        is each slot's plain decode step; rows 1..k score the staged
+        drafts. The accepted prefix plus one bonus token commit
+        (greedy-exact vs the non-speculative path); the rejected tail's
+        pages roll back by block-table truncation."""
+        B = self.config.max_batch_slots
+        S = self._spec_k + 1
+        pos = np.zeros((B,), np.int32)
+        ids = np.zeros((B, S), np.int32)
+        active = np.zeros((B,), bool)
+        per_slot: List[Optional[RequestState]] = [None] * B
+        for slot, st in self._decodable():
+            pos[slot] = st.seq_len - 1
+            ids[slot, 0] = st.generated[-1]
+            n = len(st.draft)
+            if n:
+                ids[slot, 1:1 + n] = st.draft
+            active[slot] = True
+            per_slot[slot] = st
+        n_active = int(active.sum())
+        t0 = self.clock()
+        prog = self._get_verify()
+        temps, tks, tps = self._sampling_arrays(per_slot)
+        hang = chaos.active() and chaos.probe("serve.decode.hang")
+        tok0, greedy, ok_rows, new_k, new_v = self._guarded_dispatch(
+            "verify", prog,
+            (self.params, self.cache.k, self.cache.v,
+             self._decode_table(per_slot), jnp.asarray(pos),
+             jnp.asarray(ids), jnp.asarray(active), self._next_key(),
+             temps, tks, tps, self._poison_array(per_slot)),
+            hang=hang)
+        self.cache.update(new_k, new_v)
+        tok0 = np.asarray(tok0)
+        greedy = np.asarray(greedy)
+        ok_rows = np.asarray(ok_rows)
+        now = self.clock()
+        dt = now - t0
+        st_ = self._stats
+        st_["decode_dispatches"] += 1
+        st_["verify_dispatches"] += 1
+        st_["decode_slot_steps"] += n_active
+        st_["decode_batch_max"] = max(st_["decode_batch_max"], n_active)
+        self._observe("decode_step", dt)
+        reg = get_registry()
+        reg.histogram("serve_decode_step_seconds",
+                      "decode dispatch wall time (all slots)").observe(dt)
+        reg.histogram("serve_decode_occupancy",
+                      "active slots per decode dispatch",
+                      buckets=tuple(range(1, B + 1))).observe(n_active)
+        accepted = rolled_back = 0
+        for slot, st in [(s, x) for s, x in enumerate(per_slot)
+                         if x is not None]:
+            n = len(st.draft)
+            tr = st.trace
+            if tr is not None:
+                tr.end_span(tr.start_span(
+                    f"verify[{len(st.generated)}]",
+                    parent=st.trace_spans.get("admitted"), t=t0,
+                    batch=n_active, proposed=n), t=now)
+            if not ok_rows[slot, 0]:
+                st.draft = []
+                self.scheduler.fail(st, "non-finite logits at decode")
+                continue
+            # greedy acceptance: draft i survives iff it equals the
+            # verifier's argmax at the previous row AND that row's
+            # logits are finite (pad/garbage rows never commit)
+            n_acc = 0
+            while n_acc < n and ok_rows[slot, n_acc] \
+                    and st.draft[n_acc] == int(greedy[slot, n_acc]):
+                n_acc += 1
+            commit = [int(tok0[slot])] + \
+                [int(greedy[slot, i]) for i in range(1, n_acc + 1)
+                 if ok_rows[slot, i]]
+            committed = 0
+            for t in commit:
+                self._accept_token(st, t, now)
+                committed += 1
+                if st.terminal or st.is_done():
+                    break
+            acc = max(0, committed - 1)
+            accepted += acc
+            rolled_back += n - acc
+            st.draft = []
+            if not st.terminal:
+                # block-table truncation: pages holding only the
+                # rejected tail's K/V leave the table now (_accept_token
+                # already finished any done request — its pages went
+                # back wholesale through _terminate)
+                self.cache.truncate_slot(st.slot, st.seq_len)
+        if accepted:
+            st_["spec_accepted"] += accepted
+            reg.counter("serve_spec_accepted_total",
+                        "speculative draft tokens accepted and "
+                        "committed").inc(accepted)
+        if rolled_back:
+            st_["spec_rolled_back"] += rolled_back
+            reg.counter("serve_spec_rolled_back_total",
+                        "speculative draft tokens rejected and rolled "
+                        "back by block-table truncation").inc(
+                rolled_back)
+
     def _run_decode(self) -> None:
         B = self.config.max_batch_slots
         pos = np.zeros((B,), np.int32)
         tokens = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
         per_slot: List[Optional[RequestState]] = [None] * B
-        for slot, st in self.scheduler.active():
+        for slot, st in self._decodable():
             # the newest generated token is not yet in the cache: this
             # step writes its K/V at position seq_len-1 and attends over
             # everything up to and including it
@@ -958,7 +1300,7 @@ class ServingEngine:
         toks, ok, new_k, new_v = self._guarded_dispatch(
             "decode", prog,
             (self.params, self.cache.k, self.cache.v,
-             self.cache.table_array(), jnp.asarray(pos),
+             self._decode_table(per_slot), jnp.asarray(pos),
              jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
              temps, tks, tps, self._poison_array(per_slot)),
             hang=hang)
@@ -978,7 +1320,7 @@ class ServingEngine:
         reg.histogram("serve_decode_occupancy",
                       "active slots per decode dispatch",
                       buckets=tuple(range(1, B + 1))).observe(n_active)
-        for slot, st in list(self.scheduler.active()):
+        for slot, st in list(self._decodable()):
             tr = st.trace
             if tr is not None:
                 # decode[i]: this request's share of the batched decode
@@ -1083,6 +1425,33 @@ class ServingEngine:
         reg.gauge("serve_kv_pages_in_use",
                   "allocated KV pages (of the shared pool)").set(
             self.cache.allocator.pages_in_use)
+        if self.prefix_cache is not None:
+            self._publish_prefix_metrics(reg)
+
+    def _publish_prefix_metrics(self, reg) -> None:
+        """Delta-publish the prefix cache's host-side stats (the cache
+        itself never touches the registry — recsys tier convention).
+        Flag off ⇒ this is never called: zero new series."""
+        pc = self.prefix_cache
+        reg.gauge("serve_prefix_cached_pages",
+                  "KV pages resident in the radix prefix cache").set(
+            pc.cached_pages)
+        for stat, name, help_ in (
+                ("hits", "serve_prefix_hits_total",
+                 "admissions that matched a cached prefix"),
+                ("misses", "serve_prefix_misses_total",
+                 "admissions with no cached prefix"),
+                ("hit_tokens", "serve_prefix_hit_tokens_total",
+                 "prompt tokens served from cached pages instead of "
+                 "prefill"),
+                ("evicted_pages", "serve_prefix_evicted_pages_total",
+                 "cached pages evicted under allocation pressure")):
+            delta = pc.stats[stat] - self._prefix_published.get(stat, 0)
+            if delta > 0:
+                # emits-metrics: serve_prefix_hits_total, serve_prefix_misses_total
+                # emits-metrics: serve_prefix_hit_tokens_total, serve_prefix_evicted_pages_total
+                reg.counter(name, help_).inc(delta)
+                self._prefix_published[stat] = pc.stats[stat]
 
     # -- observability -------------------------------------------------------
     def stats(self) -> dict:
@@ -1133,6 +1502,28 @@ class ServingEngine:
                 self._stats["decode_slot_steps"]
                 / self._stats["decode_dispatches"]
                 if self._stats["decode_dispatches"] else None),
+            "ttft_p99_s": pct(lat["ttft"], 99),
+            "prefill_tokens": self._stats["prefill_tokens"],
+            "prefill_chunks": self._stats["prefill_chunks"],
+            "verify_dispatches": self._stats["verify_dispatches"],
+            # prefix hit rate: share of prompt positions served from
+            # cached pages instead of prefill compute
+            "prefix_hit_pct": (
+                100.0 * self.prefix_cache.stats["hit_tokens"]
+                / max(1, self.prefix_cache.stats["hit_tokens"]
+                      + self._stats["prefill_tokens"])
+                if self.prefix_cache is not None else None),
+            "prefix_hit_tokens": (
+                self.prefix_cache.stats["hit_tokens"]
+                if self.prefix_cache is not None else 0),
+            # draft acceptance: committed draft tokens per proposed
+            "spec_accept_pct": (
+                100.0 * self._stats["spec_accepted"]
+                / self._stats["spec_proposed"]
+                if self._stats["spec_proposed"] else None),
+            "spec_proposed": self._stats["spec_proposed"],
+            "spec_accepted": self._stats["spec_accepted"],
+            "spec_rolled_back": self._stats["spec_rolled_back"],
         }
 
     def shutdown(self) -> None:
@@ -1162,4 +1553,8 @@ class ServingEngine:
         for slot, _ in list(self.scheduler.active()):
             self.cache.free_slot(slot)
             self.scheduler.slots[slot] = None
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+            self.cache.prefix_cache = None
+            self.prefix_cache = None
         self.cache.k = self.cache.v = None

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView",
-           "PagedLayerCache", "write_pages", "gather_pages",
+           "PagedLayerCache", "ContextPagedCacheView",
+           "ContextPagedLayerCache", "write_pages", "gather_pages",
            "blocks_needed"]
 
 #: physical page 0 is never allocated: it is the shared scratch target for
@@ -71,6 +72,25 @@ class PagedLayerCache(NamedTuple):
     block_table: object
 
 
+class ContextPagedCacheView(PagedCacheView):
+    """Marker subtype of :class:`PagedCacheView` selecting the
+    **context prefill** attention path: an S>1 chunk at per-slot
+    positions ``pos`` attends over everything ALREADY IN THE PAGES
+    (positions ``< pos``) as well as causally over itself — the math
+    chunked prefill, prefix-cache-hit admission and speculative verify
+    all need, where the plain view's S>1 path assumes ``pos == 0`` and
+    attends only over its own chunk. Being a NamedTuple subtype it is
+    still a pytree, and ``isinstance(x, PagedCacheView)`` still routes
+    it into the paged forward; the CLASS carries the static bit, so the
+    dispatch choice is resolved at trace time, never on a traced
+    value."""
+
+
+class ContextPagedLayerCache(PagedLayerCache):
+    """One layer's slice of a :class:`ContextPagedCacheView` (same
+    marker contract at the attention-block level)."""
+
+
 def write_pages(pages, new, block_table, pos):
     """Scatter ``new`` ``[B, S, H, D]`` into ``pages`` ``[P, bs, H, D]``
     at logical positions ``pos[b] + 0..S-1`` through ``block_table``
@@ -97,9 +117,17 @@ def gather_pages(pages, block_table):
 
 
 class BlockAllocator:
-    """Host-side free list over the physical page pool (page 0 reserved
-    as scratch). O(1) alloc/free; allocation is all-or-nothing so a
-    half-admitted request never wedges the pool."""
+    """Host-side refcounted free list over the physical page pool (page
+    0 reserved as scratch). O(1) alloc/incref/free; allocation is
+    all-or-nothing so a half-admitted request never wedges the pool.
+
+    Refcounts are the prefix-cache currency (ISSUE 15): a page mapped
+    into N slot block tables plus the radix tree holds N+1 references;
+    :meth:`free` DECREMENTS and the page only re-enters the free list
+    when the count hits zero — no holder can ever see its page recycled
+    under it, and a page can never be freed twice (pinned by the
+    scheduler fuzz). Pages allocated by :meth:`alloc` start at count 1
+    (the pre-refcount semantics: one owner, one free)."""
 
     def __init__(self, num_pages: int, reserved: int = 1):
         if num_pages <= reserved:
@@ -109,6 +137,8 @@ class BlockAllocator:
         self.num_pages = int(num_pages)
         self.reserved = int(reserved)
         self._free = collections.deque(range(reserved, num_pages))
+        #: page -> reference count, for every currently-allocated page
+        self._rc: dict = {}
 
     @property
     def free_pages(self) -> int:
@@ -118,18 +148,44 @@ class BlockAllocator:
     def pages_in_use(self) -> int:
         return self.num_pages - self.reserved - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        """Current reference count (0 = on the free list)."""
+        return self._rc.get(int(page), 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages, or None (and no change) when the pool cannot cover
-        them — the scheduler's cue to wait or preempt."""
+        """n pages at refcount 1, or None (and no change) when the pool
+        cannot cover them — the scheduler's cue to wait or preempt."""
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._rc[p] = 1
+        return pages
+
+    def incref(self, page: int) -> None:
+        """Add a reference to an ALLOCATED page (mapping a cached
+        prefix page into another slot's block table)."""
+        page = int(page)
+        if page not in self._rc:
+            raise ValueError(f"incref on unallocated page {page}")
+        self._rc[page] += 1
 
     def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page re-enters the free list
+        only when its last reference goes."""
         for p in pages:
+            p = int(p)
             if not (self.reserved <= p < self.num_pages):
                 raise ValueError(f"freeing page {p} outside the pool")
-            self._free.append(p)
+            rc = self._rc.get(p)
+            if rc is None:
+                raise ValueError(f"double free of page {p} "
+                                 "(refcount already 0)")
+            if rc > 1:
+                self._rc[p] = rc - 1
+            else:
+                del self._rc[p]
+                self._free.append(p)
 
 
 class PagedKVCache:
@@ -155,6 +211,14 @@ class PagedKVCache:
         self._tables = np.full((max_slots, max_blocks_per_slot),
                                SCRATCH_PAGE, np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+        #: leading pages of each slot mapped COPY-ON-WRITE from the
+        #: radix prefix cache (never written by this slot: every write
+        #: lands at positions >= shared * block_size)
+        self._slot_shared: List[int] = [0] * max_slots
+        #: optional RadixPrefixCache (serving.prefix_cache): consulted
+        #: for LRU eviction when the free list cannot cover an alloc,
+        #: and fed donated pages by free_slot
+        self.prefix_cache = None
 
     # -- device-side --------------------------------------------------------
     def update(self, new_k, new_v) -> None:
@@ -182,22 +246,54 @@ class PagedKVCache:
     def slot_blocks(self, slot: int) -> int:
         return len(self._slot_pages[slot])
 
+    def slot_shared_blocks(self, slot: int) -> int:
+        """Leading COW pages mapped from the prefix cache (writes to
+        this slot must start at/after ``shared * block_size``)."""
+        return self._slot_shared[slot]
+
     def capacity_tokens(self, slot: int) -> int:
         """Token positions the slot's allocated blocks cover."""
         return self.slot_blocks(slot) * self.block_size
 
-    def alloc_slot(self, slot: int, num_tokens: int) -> bool:
-        """Allocate blocks covering ``num_tokens`` positions for a fresh
-        slot. False (state untouched) when the pool cannot cover it."""
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Allocator alloc with prefix-cache pressure relief: when the
+        free list cannot cover ``n``, evict LRU radix-tree pages until
+        it can (or the tree runs out) — cached prefixes are strictly
+        lower-value than live requests, so they leave BEFORE any
+        recompute-preemption fires."""
+        pages = self.allocator.alloc(n)
+        while pages is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict_for(
+                    n - self.allocator.free_pages) <= 0:
+                break
+            pages = self.allocator.alloc(n)
+        return pages
+
+    def alloc_slot(self, slot: int, num_tokens: int,
+                   shared_pages: Sequence[int] = ()) -> bool:
+        """Allocate blocks covering ``num_tokens`` positions for a
+        fresh slot. ``shared_pages`` are prefix-cache hits (already
+        incref'd by the match) mapped read-only at the head of the
+        block table; only the remainder is newly allocated. False when
+        the pool cannot cover the remainder — the shared references are
+        dropped again, so a failed admission leaks nothing."""
         if self._slot_pages[slot]:
             raise RuntimeError(f"slot {slot} already holds pages; "
                                "free_slot first")
-        pages = self.allocator.alloc(
-            blocks_needed(num_tokens, self.block_size))
+        shared = list(shared_pages)
+        need = blocks_needed(num_tokens, self.block_size)
+        if len(shared) > need:
+            raise ValueError(
+                f"slot {slot}: {len(shared)} shared pages exceed the "
+                f"{need} blocks {num_tokens} tokens need")
+        pages = self._alloc(need - len(shared))
         if pages is None:
+            if shared:
+                self.allocator.free(shared)
             return False
-        self._slot_pages[slot] = pages
-        self._tables[slot, :len(pages)] = pages
+        self._slot_pages[slot] = shared + pages
+        self._slot_shared[slot] = len(shared)
+        self._tables[slot, :need] = self._slot_pages[slot]
         return True
 
     def extend_slot(self, slot: int, num_tokens: int) -> bool:
@@ -212,16 +308,50 @@ class PagedKVCache:
             raise ValueError(
                 f"slot {slot}: {num_tokens} tokens exceed the "
                 f"{self.max_context_len}-token slot capacity")
-        pages = self.allocator.alloc(need - have)
+        pages = self._alloc(need - have)
         if pages is None:
             return False
         self._slot_pages[slot].extend(pages)
         self._tables[slot, have:need] = pages
         return True
 
-    def free_slot(self, slot: int) -> None:
+    def truncate_slot(self, slot: int, num_tokens: int) -> int:
+        """Shrink the slot to cover only ``num_tokens`` positions — the
+        speculative-decode rollback: pages holding ONLY rejected draft
+        K/V leave the block table and drop their reference. Never cuts
+        into the COW-shared prefix (committed tokens always cover it).
+        Returns the number of pages released."""
+        keep = blocks_needed(num_tokens, self.block_size)
+        pages = self._slot_pages[slot]
+        if keep >= len(pages):
+            return 0
+        if keep < self._slot_shared[slot]:
+            raise ValueError(
+                f"slot {slot}: truncation to {num_tokens} tokens would "
+                f"cut into the {self._slot_shared[slot]} shared prefix "
+                "pages — committed tokens must cover the shared prefix")
+        tail = pages[keep:]
+        self.allocator.free(tail)
+        self._slot_pages[slot] = pages[:keep]
+        self._tables[slot, keep:] = SCRATCH_PAGE
+        return len(tail)
+
+    def free_slot(self, slot: int,
+                  donate_tokens: Optional[Sequence[int]] = None) -> None:
+        """Release the slot's pages (one reference each). With a prefix
+        cache attached and ``donate_tokens`` — the token ids whose K/V
+        the slot's pages VALIDLY hold, in order — full pages are donated
+        into the radix tree instead (ownership of this slot's reference
+        transfers; duplicates of already-cached paths are simply
+        dropped), so completed/evicted requests seed future prefix
+        hits."""
         pages = self._slot_pages[slot]
         if pages:
-            self.allocator.free(pages)
+            donated = 0
+            if self.prefix_cache is not None and donate_tokens is not None:
+                donated = self.prefix_cache.donate(donate_tokens, pages)
+            if donated < len(pages):
+                self.allocator.free(pages[donated:])
         self._slot_pages[slot] = []
+        self._slot_shared[slot] = 0
         self._tables[slot, :] = SCRATCH_PAGE

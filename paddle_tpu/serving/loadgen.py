@@ -77,6 +77,17 @@ class LoadSpec:
     deadline_range: Optional[Tuple[float, float]] = None
     #: uniform per-request priority sample; None = all priority 0
     priority_choices: Optional[Tuple[int, ...]] = None
+    #: chat-style shared prefixes (ISSUE 15): > 0 = every prompt opens
+    #: with one of ``prefix_pool_size`` fixed prefixes of this many
+    #: tokens (a "system prompt"), drawn with bounded-zipf reuse so a
+    #: hot head of prefixes dominates — the traffic shape the radix
+    #: prefix cache exists for (BENCH_serve measures hit rate on it).
+    #: 0 (default) = no prefixes, byte-identical to pre-ISSUE-15 specs.
+    shared_prefix_len: int = 0
+    #: number of distinct prefixes in the pool
+    prefix_pool_size: int = 8
+    #: zipf exponent of prefix reuse (rank==index; higher = hotter head)
+    prefix_zipf: float = 1.1
 
 
 class TokenBucket:
@@ -149,9 +160,27 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
     out = []
     lo_p, hi_p = spec.prompt_len_range
     lo_n, hi_n = spec.max_new_range
+    prefixes = prefix_cdf = None
+    if spec.shared_prefix_len > 0:
+        # the prefix pool and its zipf CDF draw from a fixed-seed side
+        # generator, so enabling prefixes perturbs NOTHING about the
+        # default draws below (arrivals/lengths/tails replay exactly)
+        prng = np.random.default_rng(spec.seed ^ 0x5A5A)
+        prefixes = prng.integers(
+            0, spec.vocab_size,
+            (max(1, spec.prefix_pool_size), spec.shared_prefix_len)
+        ).astype(np.int32)
+        w = 1.0 / np.power(
+            np.arange(1, prefixes.shape[0] + 1, dtype=np.float64),
+            float(spec.prefix_zipf))
+        prefix_cdf = np.cumsum(w / w.sum())
     for i in range(spec.num_requests):
         plen = int(rng.integers(lo_p, hi_p + 1))
         prompt = rng.integers(0, spec.vocab_size, (plen,)).astype(np.int32)
+        if prefixes is not None:
+            pi = int(np.searchsorted(prefix_cdf, rng.random()))
+            prompt = np.concatenate([prefixes[min(pi, len(prefix_cdf)
+                                                  - 1)], prompt])
         deadline = None
         if spec.deadline_range is not None:
             lo_d, hi_d = spec.deadline_range

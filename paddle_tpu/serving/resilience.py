@@ -302,6 +302,15 @@ def request_spec(st) -> dict:
         "eos_token_id": (None if req.eos_token_id is None
                          else int(req.eos_token_id)),
         "priority": int(getattr(req, "priority", 0)),
+        # ISSUE 15: chunked-prefill progress and in-flight (uncommitted)
+        # draft tokens at drain time. Neither changes what the successor
+        # RECOMPUTES — `generated` holds only committed tokens, so
+        # resuming from prompt+generated is token-exact whether the
+        # drain landed mid-chunk or mid-verify — but recording them
+        # keeps the snapshot an honest picture of undone work (the
+        # torn-commit drill asserts both survive the round-trip).
+        "prefill_pos": int(getattr(st, "prefill_pos", 0)),
+        "draft": [int(t) for t in getattr(st, "draft", ())],
     }
     # trace-context survival: the successor engine resumes the SAME
     # trace_id (monitor/trace.py), so a drained request's span tree

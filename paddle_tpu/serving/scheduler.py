@@ -130,6 +130,20 @@ class RequestState:
         self.stop_hit = False
         #: chaos serve.request.poison marked this request
         self.poisoned = False
+        #: prompt positions whose K/V are already in the slot's pages
+        #: (ISSUE 15): admission seeds it with the prefix-cache hit
+        #: length; chunked prefill advances it per chunk. Reset on
+        #: preemption (the pages are gone).
+        self.prefill_pos = 0
+        #: effective-prompt length this residency must prefill (set at
+        #: admission — effective_prompt() grows as tokens generate, so
+        #: the target is stamped, not recomputed)
+        self.prefill_len: Optional[int] = None
+        #: speculative draft tokens proposed for the NEXT verify
+        #: dispatch (uncommitted: never part of ``generated`` until the
+        #: verifier accepts them; drain snapshots record them as
+        #: in-flight work, restore recomputes them)
+        self.draft: List[int] = []
         #: structured-tracing context (monitor/trace.py): the engine
         #: attaches a Trace + open-span handles when FLAGS_trace is on;
         #: the scheduler itself never touches them (same division of
@@ -158,6 +172,35 @@ class RequestState:
 
     def remaining_new_tokens(self) -> int:
         return self.request.max_new_tokens - len(self.generated)
+
+    @property
+    def prefilling(self) -> bool:
+        """Holds a slot but has not finished its (possibly chunked)
+        prefill — it takes no decode row yet."""
+        return self.slot is not None and self.prefill_len is not None \
+            and self.prefill_pos < self.prefill_len
+
+    @property
+    def phase(self) -> Optional[str]:
+        """Slot phase for /statusz and docs/SERVING.md's state machine:
+        ``prefilling`` | ``verifying`` (a speculative draft is staged
+        for / aboard a verify dispatch) | ``decoding``; None while not
+        resident."""
+        if self.slot is None:
+            return None
+        if self.prefilling:
+            return "prefilling"
+        return "verifying" if self.draft else "decoding"
+
+    def written_tokens(self) -> np.ndarray:
+        """The token ids whose K/V this slot's pages VALIDLY hold right
+        now — the prefix-cache donation payload. Mid-prefill that is
+        the chunk progress; decoding it is everything but the newest
+        generated token (whose K/V the next dispatch writes)."""
+        eff = self.effective_prompt()
+        if self.prefilling or not self.generated:
+            return eff[:self.prefill_pos]
+        return eff[:self.seq_len - 1]
 
     def is_done(self) -> bool:
         if self.stop_hit:
@@ -266,7 +309,12 @@ class Scheduler:
         assert st.outcome is None, \
             f"request {st.request.request_id} already {st.outcome}"
         if st.slot is not None:
-            self.cache.free_slot(st.slot)
+            # prefix-cache donation (ISSUE 15): the K/V this residency
+            # computed seeds future prefix hits — except a FAILED
+            # request's (a non-finite forward may have written garbage)
+            donate = (st.written_tokens()
+                      if outcome != "failed" else None)
+            self.cache.free_slot(st.slot, donate_tokens=donate)
             self.slots[st.slot] = None
             st.slot = None
         st.outcome = outcome
@@ -460,6 +508,8 @@ class Scheduler:
                  "prompt_len": st.prompt_len,
                  "generated": len(st.generated),
                  "seq_len": st.seq_len,
+                 "phase": st.phase,
+                 "prefill_pos": st.prefill_pos,
                  "preemptions": st.preemptions}
                 for slot, st in enumerate(slots) if st is not None],
             "stats": dict(self.stats),
@@ -471,11 +521,14 @@ class Scheduler:
             st is not None for st in self.slots)
 
     # -- admission ----------------------------------------------------------
-    def plan_admissions(self) -> List[AdmissionGroup]:
-        """Admit as many waiting requests as slots + pages allow, FIFO,
-        and group them into bucketed prefill dispatches. Allocation is
-        done here (slot assigned, pages for the effective prompt), so a
-        returned group is guaranteed runnable."""
+    def plan_admissions(self) -> List[RequestState]:
+        """Admit as many waiting requests as slots + pages allow, FIFO:
+        slot assigned, pages allocated for the effective prompt (with
+        any prefix-cache hit mapped COW), ``prefill_pos``/``prefill_len``
+        stamped. Returns the newly admitted states in admission order —
+        grouping them into bucketed prefill dispatches is the engine's
+        job (``ServingEngine._plan_prefill_groups``, ONE grouping path
+        that also carries chunked-prefill continuations)."""
         admitted: List[Tuple[int, RequestState]] = []
         free_slots = [i for i, st in enumerate(self.slots) if st is None]
         if self.waiting and free_slots and chaos.active() \
@@ -490,28 +543,28 @@ class Scheduler:
                 self._terminate(st, "cancelled")
                 continue
             slot = free_slots[0]
-            if not self.cache.alloc_slot(slot, st.effective_prompt().size):
+            eff = st.effective_prompt()
+            # radix prefix cache (ISSUE 15): map the longest cached
+            # page-aligned prefix copy-on-write into the block-table
+            # head; the slot prefills only the tail. match() incref'd
+            # the hit pages; a failed alloc drops them again inside
+            # alloc_slot, so the retry next iteration re-matches.
+            n_hit, shared = 0, ()
+            if self.cache.prefix_cache is not None:
+                n_hit, shared = self.cache.prefix_cache.match(eff)
+            if not self.cache.alloc_slot(slot, eff.size,
+                                         shared_pages=shared):
                 break                      # page pool dry: FIFO blocks
             self.waiting.pop(0)
             free_slots.pop(0)
             st.slot = slot
             st.admitted_t = self.clock()
+            st.prefill_pos = n_hit
+            st.prefill_len = int(eff.size)
             self.slots[slot] = st
             admitted.append((slot, st))
             self.stats["admitted"] += 1
-        groups: List[AdmissionGroup] = []
-        by_len = {}
-        for slot, st in admitted:
-            lb = self.buckets.len_bucket(st.effective_prompt().size)
-            by_len.setdefault(lb, []).append(st)
-        for lb in sorted(by_len):
-            sts = by_len[lb]
-            mb = self.buckets.max_batch
-            for i in range(0, len(sts), mb):
-                chunk = sts[i:i + mb]
-                groups.append(AdmissionGroup(
-                    lb, self.buckets.batch_bucket(len(chunk)), chunk))
-        return groups
+        return [st for _, st in admitted]
 
     # -- decode-time growth / preemption ------------------------------------
     def ensure_decode_capacity(self) -> List[RequestState]:
@@ -540,8 +593,13 @@ class Scheduler:
                 continue                       # preempted below, skip
             # this decode step writes position seq_len-1 (the newest
             # generated token's K/V) -> the slot must cover seq_len
-            # positions
-            while not self.cache.extend_slot(slot, st.seq_len):
+            # positions; a staged speculative draft writes its k tokens
+            # at the following positions, so the slot must also cover
+            # them BEFORE the verify dispatch (a draft's K/V must never
+            # spill into the shared scratch page — rows of the verify
+            # window read it back)
+            while not self.cache.extend_slot(
+                    slot, st.seq_len + len(st.draft)):
                 victim = self._newest_active(exclude=st)
                 if victim is None:
                     raise RuntimeError(
@@ -562,10 +620,18 @@ class Scheduler:
 
     def _preempt(self, st: RequestState, count: bool = True) -> None:
         assert st.slot is not None
-        self.cache.free_slot(st.slot)
+        # evicted residencies donate too (vLLM/SGLang recompute policy
+        # meets the radix cache): the pages stay warm in the tree, so a
+        # re-admission — or any sibling sharing the prefix — hits them
+        # instead of re-prefilling; allocation pressure evicts them LRU
+        self.cache.free_slot(st.slot,
+                             donate_tokens=st.written_tokens())
         self.slots[st.slot] = None
         st.slot = None
         st.admitted_t = None
+        st.prefill_pos = 0
+        st.prefill_len = None
+        st.draft = []
         if count:
             st.preemptions += 1
             self.stats["preemptions"] += 1

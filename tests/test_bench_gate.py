@@ -194,6 +194,33 @@ def test_recsys_hit_rate_unit_gates_on_absolute_points_drop():
     assert check_bench.compare(old, up, tolerance=0.10) == []
 
 
+def test_spec_accept_unit_gates_on_absolute_points_drop():
+    """accept% (speculative-decoding draft acceptance, BENCH_serve's
+    serve_spec_accept_pct) is higher-is-better on ABSOLUTE points: a
+    healthy acceptance rate can sit anywhere in 0-100 depending on how
+    self-repetitive the workload is, so a relative band is meaningless
+    and a collapse must trip even off a modest baseline."""
+    old = [_m("serve_spec_accept_pct", 55.0, "accept%")]
+    ok = [_m("serve_spec_accept_pct", 47.0, "accept%")]    # -8 pts
+    bad = [_m("serve_spec_accept_pct", 40.0, "accept%")]   # -15 pts
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "-15.0 points" in problems[0]
+    # direction: better acceptance never trips
+    up = [_m("serve_spec_accept_pct", 95.0, "accept%")]
+    assert check_bench.compare(old, up, tolerance=0.10) == []
+
+
+def test_serve_prefix_hit_rides_hit_pct_unit():
+    """serve_prefix_hit_pct reuses the recsys hit% unit: absolute
+    points, drop = regression (a fallen hit rate means shared-prefix
+    traffic went back to paying full prefill)."""
+    old = [_m("serve_prefix_hit_pct", 60.0, "hit%")]
+    bad = [_m("serve_prefix_hit_pct", 45.0, "hit%")]       # -15 pts
+    assert check_bench.compare(old, bad, tolerance=0.10)
+    assert check_bench.compare(old, old, tolerance=0.10) == []
+
+
 def test_recsys_examples_per_sec_is_rate_like():
     """examples/s (DLRM training/serving throughput) gates like
     tokens/s: relative, shrink = regression."""

@@ -1,0 +1,704 @@
+"""Frontier decode throughput (ISSUE 15): radix prefix cache over the
+paged KV pools, chunked prefill, speculative decoding — each behind its
+own kill switch with the flags-off path as the token-exact oracle, plus
+refcounted BlockAllocator invariants under the scheduler fuzz."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.core.tensor import no_grad
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+from paddle_tpu.monitor import scoped_registry
+from paddle_tpu.serving import (BlockAllocator, EngineDrained,
+                                LoadSpec, RadixPrefixCache, Request,
+                                SamplingParams, ServingConfig,
+                                ServingEngine, build_requests,
+                                load_drain_snapshot, propose_ngram,
+                                requests_from_snapshot)
+from paddle_tpu.serving.kv_cache import PagedKVCache
+from paddle_tpu.serving.resilience import request_spec
+from paddle_tpu.serving.scheduler import BucketTable, Scheduler
+from paddle_tpu.testing import chaos
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return GPTForPretraining(gpt_tiny())
+
+
+def _engine(model, **kw):
+    cfg = dict(max_batch_slots=3, block_size=4, max_context_len=64,
+               prefill_buckets=(8, 16), batch_buckets=(1, 2))
+    cfg.update(kw)
+    return ServingEngine(model, ServingConfig(**cfg))
+
+
+def _golden(model, prompt, n):
+    seq = np.asarray(prompt, np.int32)
+    for _ in range(n):
+        with no_grad():
+            lg = model(paddle.to_tensor(seq[None, :])).numpy()
+        seq = np.concatenate([seq, [np.int32(lg[0, -1].argmax())]])
+    return seq
+
+
+#: a prompt whose greedy continuation the n-gram drafter can predict
+#: (trailing n-gram recurs), plus generic shared-prefix prompts
+REP_PROMPT = [3, 4, 5, 3, 4, 5, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# refcounted BlockAllocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcounts():
+    a = BlockAllocator(num_pages=6)
+    got = a.alloc(2)
+    assert [a.refcount(p) for p in got] == [1, 1]
+    a.incref(got[0])
+    assert a.refcount(got[0]) == 2
+    a.free(got)                       # got[0] -> rc 1, got[1] -> freed
+    assert a.refcount(got[0]) == 1 and a.refcount(got[1]) == 0
+    assert a.pages_in_use == 1
+    a.free([got[0]])
+    assert a.pages_in_use == 0
+    with pytest.raises(ValueError):
+        a.free([got[0]])              # double free is loud
+    with pytest.raises(ValueError):
+        a.incref(got[1])              # incref needs an allocated page
+
+
+def test_allocator_shared_page_never_reenters_free_list_early():
+    a = BlockAllocator(num_pages=4)
+    got = a.alloc(3)                  # pool exhausted
+    a.incref(got[1])
+    a.free(got)                       # got[1] still referenced
+    assert a.refcount(got[1]) == 1
+    re = a.alloc(3)                   # only 2 free -> all-or-nothing
+    assert re is None
+    assert sorted(a.alloc(2)) == sorted([got[0], got[2]])
+
+
+# ---------------------------------------------------------------------------
+# radix tree unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def _host_cache(num_pages=12, block_size=4, max_slots=3):
+    return PagedKVCache(1, 1, 4, num_pages=num_pages,
+                        block_size=block_size, max_slots=max_slots,
+                        max_blocks_per_slot=6)
+
+
+def test_radix_donate_match_dedup_evict():
+    cache = _host_cache()
+    pc = RadixPrefixCache(cache)
+    cache.prefix_cache = pc
+    alloc = cache.allocator
+    toks = list(range(10, 22))        # 3 full pages at bs=4
+    pages = alloc.alloc(3)
+    assert pc.donate(toks, pages) == 3
+    assert pc.cached_pages == 3 and alloc.pages_in_use == 3
+
+    # full-prefix query: capped one token short -> only 2 pages match
+    n, hit = pc.match(toks)
+    assert n == 8 and len(hit) == 2 and hit == pages[:2]
+    assert [alloc.refcount(p) for p in hit] == [2, 2]
+    alloc.free(hit)
+
+    # longer query with an extra tail matches all 3 pages
+    n, hit = pc.match(toks + [99, 98])
+    assert n == 12 and hit == pages
+    alloc.free(hit)
+
+    # duplicate donation drops the duplicate refs, tree unchanged
+    dup = alloc.alloc(3)
+    assert pc.donate(toks, dup) == 3
+    assert pc.cached_pages == 3
+    assert all(alloc.refcount(p) == 0 for p in dup)
+
+    # divergent branch shares the common prefix node
+    toks2 = toks[:4] + [77, 78, 79, 80]
+    pg2 = alloc.alloc(2)
+    assert pc.donate(toks2, pg2) == 2
+    assert pc.cached_pages == 4       # shared head + one new leaf
+    assert alloc.refcount(pg2[0]) == 0 and alloc.refcount(pg2[1]) == 1
+
+    # eviction storm: drop everything; no page leaks, free list whole
+    freed = pc.evict_for(100)
+    assert freed == 4 and pc.cached_pages == 0
+    assert alloc.pages_in_use == 0
+
+
+def test_radix_eviction_respects_live_slot_refs():
+    cache = _host_cache()
+    pc = RadixPrefixCache(cache)
+    cache.prefix_cache = pc
+    alloc = cache.allocator
+    toks = list(range(30, 38))
+    pages = alloc.alloc(2)
+    pc.donate(toks, pages)
+    n, hit = pc.match(toks + [1, 2])
+    assert hit == pages
+    pc.evict_for(100)                 # tree drops its refs...
+    assert pc.cached_pages == 0
+    # ...but the matched slot still holds the pages
+    assert all(alloc.refcount(p) == 1 for p in pages)
+    assert alloc.pages_in_use == 2
+    alloc.free(pages)
+    assert alloc.pages_in_use == 0
+
+
+def test_alloc_slot_failure_drops_shared_refs():
+    cache = _host_cache(num_pages=4)  # 3 allocatable
+    pc = RadixPrefixCache(cache)
+    cache.prefix_cache = pc
+    alloc = cache.allocator
+    pages = alloc.alloc(2)
+    pc.donate(list(range(8)), pages)
+    n, hit = pc.match(list(range(8)) + [5, 6, 7, 8, 9])
+    assert len(hit) == 2
+    # needs 3 blocks beyond the shared 2 with only 1 free: allocation
+    # pressure first evicts the tree (whose pages are the shared ones,
+    # still match-referenced, so eviction frees nothing) and the alloc
+    # still fails — the failed admission must then drop the match refs
+    # so NOTHING leaks: every page back on the free list
+    ok = cache.alloc_slot(0, 20, shared_pages=hit)
+    assert not ok
+    assert pc.cached_pages == 0             # evicted under pressure
+    assert all(alloc.refcount(p) == 0 for p in pages)
+    assert alloc.pages_in_use == 0
+
+
+def test_truncate_slot_releases_only_tail_pages():
+    cache = _host_cache()
+    alloc = cache.allocator
+    assert cache.alloc_slot(0, 20)    # 5 blocks
+    assert alloc.pages_in_use == 5
+    assert cache.truncate_slot(0, 9) == 2      # 9 tokens -> 3 blocks
+    assert alloc.pages_in_use == 3
+    assert cache.truncate_slot(0, 9) == 0      # idempotent
+    table = np.asarray(cache.table_array())
+    assert (table[0, 3:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# n-gram drafter
+# ---------------------------------------------------------------------------
+
+
+def test_propose_ngram_prompt_lookup():
+    # trailing [3,4] recurs -> continuation [5,3,4] follows it
+    d = propose_ngram([3, 4, 5, 3, 4], k=3)
+    assert d.tolist() == [5, 3, 4]
+    # longest n-gram wins: trailing trigram picks the right branch
+    d = propose_ngram([1, 2, 3, 9, 2, 3, 7, 1, 2, 3], k=2, max_ngram=3)
+    assert d.tolist() == [9, 2]
+    # no recurrence -> empty
+    assert propose_ngram([1, 2, 3, 4, 5], k=4).size == 0
+    # k caps the draft
+    assert propose_ngram([3, 4, 5, 3, 4], k=1).tolist() == [5]
+    assert propose_ngram([7], k=2).size == 0
+
+
+# ---------------------------------------------------------------------------
+# token-exact oracle pins (each feature alone, then composed)
+# ---------------------------------------------------------------------------
+
+
+def _prompt_set(rng):
+    pre = rng.integers(2, 250, (10,)).tolist()
+    return [pre + rng.integers(2, 250, (4,)).tolist(),
+            pre + rng.integers(2, 250, (7,)).tolist(),
+            REP_PROMPT,
+            rng.integers(2, 250, (5,)).tolist()]
+
+
+@pytest.fixture(scope="module")
+def oracle(tiny_model):
+    rng = np.random.default_rng(42)
+    prompts = _prompt_set(rng)
+    eng = _engine(tiny_model)
+    outs = eng.generate(prompts, max_new_tokens=6)
+    base = {"prompts": prompts,
+            "outs": [o.tolist() for o in outs],
+            "decode_dispatches": eng._stats["decode_dispatches"],
+            "prefill_tokens": eng._stats["prefill_tokens"]}
+    eng.shutdown()
+    for p, o in zip(prompts, outs):
+        assert np.array_equal(o, _golden(tiny_model, p, 6))
+    return base
+
+
+def test_prefix_hit_admission_token_exact(tiny_model, oracle):
+    with flag_scope("serve_prefix_cache", True):
+        eng = _engine(tiny_model)
+    outs = []
+    for p in oracle["prompts"]:       # sequential -> later ones hit
+        outs.append(eng.generate([p], max_new_tokens=6)[0].tolist())
+    assert outs == oracle["outs"]
+    pc = eng.prefix_cache
+    assert pc.stats["hit_tokens"] > 0 and pc.stats["hits"] >= 1
+    # the hit prompts paid fewer prefill tokens than the cold oracle
+    assert eng._stats["prefill_tokens"] \
+        < oracle["prefill_tokens"] + pc.stats["hit_tokens"]
+    s = eng.metrics_summary()
+    assert s["prefix_hit_pct"] > 0
+    eng.shutdown()
+
+
+def test_prefix_shared_pages_never_mutated(tiny_model):
+    """COW: after a hit admission decodes on top of shared pages, the
+    shared pages' device content is bit-identical to before."""
+    rng = np.random.default_rng(3)
+    pre = rng.integers(2, 250, (12,)).tolist()
+    with flag_scope("serve_prefix_cache", True):
+        eng = _engine(tiny_model)
+    eng.generate([pre + [7, 8, 9]], max_new_tokens=4)
+    pc = eng.prefix_cache
+    shared = sorted(p for p in pc._nodes)
+    assert shared
+    k_before = np.asarray(eng.cache.k[:, shared])
+    v_before = np.asarray(eng.cache.v[:, shared])
+    eng.generate([pre + [11, 12]], max_new_tokens=6)
+    assert pc.stats["hits"] >= 1
+    np.testing.assert_array_equal(k_before,
+                                  np.asarray(eng.cache.k[:, shared]))
+    np.testing.assert_array_equal(v_before,
+                                  np.asarray(eng.cache.v[:, shared]))
+    eng.shutdown()
+
+
+def test_chunked_prefill_token_exact_and_interleaved(tiny_model, oracle):
+    with flag_scope("serve_prefill_chunk", 4):
+        eng = _engine(tiny_model)
+    outs = [o.tolist()
+            for o in eng.generate(oracle["prompts"], max_new_tokens=6)]
+    assert outs == oracle["outs"]
+    assert eng._stats["prefill_chunks"] > len(oracle["prompts"])
+    eng.shutdown()
+
+    # fairness: a short request admitted next to a long chunking
+    # prefill gets decode iterations BETWEEN the long one's chunks —
+    # it finishes while the long prompt is still prefilling
+    long_p = np.random.default_rng(5).integers(2, 250, (48,)).tolist()
+    with flag_scope("serve_prefill_chunk", 4):
+        eng2 = _engine(tiny_model, max_context_len=64,
+                       prefill_buckets=(4, 8, 16, 64))
+    st_long = eng2.submit(Request(long_p, max_new_tokens=4))
+    st_short = eng2.submit(Request([5, 6, 7], max_new_tokens=2))
+    while not st_short.terminal:
+        eng2.step()
+        assert st_long.prefill_pos <= 48
+    # the short stream completed while the long prompt was mid-chunk
+    assert st_long.prefilling and not st_long.terminal
+    eng2.run()
+    assert st_long.outcome == "completed"
+    out = np.concatenate([st_long.request.prompt,
+                          np.asarray(st_long.generated, np.int32)])
+    assert np.array_equal(out, _golden(tiny_model, long_p, 4))
+    eng2.shutdown()
+
+
+def test_interleaved_decode_never_writes_prefilling_slot_pages(
+        tiny_model):
+    """An interleaved decode/verify dispatch masks non-decodable rows'
+    SAMPLING only — its per-row K/V scatter is unconditional. The
+    dispatch must therefore carry an all-scratch table row for a
+    mid-chunk prefilling slot, or its (pos=0, token=0) row silently
+    overwrites the slot's first real — possibly COW-shared — page
+    (caught by review; pinned on device content, not just outputs)."""
+    long_p = np.random.default_rng(6).integers(2, 250, (48,)).tolist()
+    with flag_scope("serve_prefill_chunk", 4), \
+            flag_scope("serve_spec_k", 2):
+        eng = _engine(tiny_model, max_context_len=64,
+                      prefill_buckets=(4, 8, 16, 64))
+    # the long prompt prefills ALONE first: its chunk steps run no
+    # decode at all, so the snapshot below is pristine chunk output
+    st_long = eng.submit(Request(long_p, max_new_tokens=2))
+    eng.step()
+    assert st_long.prefilling
+    head = eng.cache._slot_pages[st_long.slot][0]
+    k_before = np.asarray(eng.cache.k[:, head])
+    v_before = np.asarray(eng.cache.v[:, head])
+    # now a short request joins, completes its prefill and DECODES in
+    # the same iterations the long prompt is still chunking through —
+    # each of those decode/verify dispatches would scatter (pos=0,
+    # token=0) garbage into the long slot's head page if its real
+    # table row were aboard
+    st_short = eng.submit(Request(REP_PROMPT, max_new_tokens=8))
+    while st_long.prefilling:
+        eng.step()
+        np.testing.assert_array_equal(
+            k_before, np.asarray(eng.cache.k[:, head]))
+        np.testing.assert_array_equal(
+            v_before, np.asarray(eng.cache.v[:, head]))
+    assert st_short.generated        # decodes really interleaved
+    eng.run()
+    out = np.concatenate([st_long.request.prompt,
+                          np.asarray(st_long.generated, np.int32)])
+    assert np.array_equal(out, _golden(tiny_model, long_p, 2))
+    assert np.array_equal(
+        np.concatenate([st_short.request.prompt,
+                        np.asarray(st_short.generated, np.int32)]),
+        _golden(tiny_model, REP_PROMPT, 8))
+    eng.shutdown()
+
+
+def test_spec_decode_token_exact_fewer_dispatches(tiny_model):
+    eng = _engine(tiny_model)
+    base = eng.generate([REP_PROMPT], max_new_tokens=10)[0]
+    base_dispatches = eng._stats["decode_dispatches"]
+    eng.shutdown()
+    with flag_scope("serve_spec_k", 3):
+        eng2 = _engine(tiny_model)
+    out = eng2.generate([REP_PROMPT], max_new_tokens=10)[0]
+    assert np.array_equal(out, base)
+    st = eng2._stats
+    assert st["spec_proposed"] > 0 and st["spec_accepted"] > 0
+    assert st["verify_dispatches"] > 0
+    # accepted drafts rode shared verify dispatches: strictly fewer
+    # decode-phase dispatches than one-token-per-dispatch
+    assert st["decode_dispatches"] < base_dispatches
+    s = eng2.metrics_summary()
+    assert s["spec_accept_pct"] > 0
+    eng2.shutdown()
+
+
+def test_spec_rollback_truncates_rejected_tail(tiny_model):
+    """A draft the verifier rejects is rolled back: counters record the
+    rollback and the slot's pages cover only committed tokens."""
+    with flag_scope("serve_spec_k", 4), flag_scope("serve_spec_ngram", 1):
+        eng = _engine(tiny_model)
+    # 1-gram lookup on a prompt whose repetition the model's greedy
+    # continuation does NOT follow forever -> some drafts miss
+    rng = np.random.default_rng(9)
+    p = rng.integers(2, 250, (6,)).tolist()
+    prompt = p + p[:3]
+    out = eng.generate([prompt], max_new_tokens=8)[0]
+    assert np.array_equal(out, _golden(tiny_model, prompt, 8))
+    st = eng._stats
+    assert st["spec_proposed"] == st["spec_accepted"] \
+        + st["spec_rolled_back"]
+    assert eng.cache.allocator.pages_in_use == 0      # all released
+    eng.shutdown()
+
+
+def test_sampled_slots_ride_verify_row0(tiny_model):
+    """temperature>0 slots never draft but still decode (row 0 of the
+    verify dispatch) — mixed batches compose."""
+    with flag_scope("serve_spec_k", 3):
+        eng = _engine(tiny_model)
+    sts = [eng.submit(Request(REP_PROMPT, max_new_tokens=6)),
+           eng.submit(Request([9, 8, 7, 6], max_new_tokens=6,
+                              sampling=SamplingParams(temperature=0.8,
+                                                      top_k=40)))]
+    eng.run()
+    assert all(st.outcome == "completed" for st in sts)
+    assert len(sts[1].generated) == 6
+    # the greedy slot's stream is still the oracle's
+    out = np.concatenate([sts[0].request.prompt,
+                          np.asarray(sts[0].generated, np.int32)])
+    assert np.array_equal(out, _golden(tiny_model, REP_PROMPT, 6))
+    eng.shutdown()
+
+
+def test_all_three_composed_token_exact(tiny_model, oracle):
+    with flag_scope("serve_prefix_cache", True), \
+            flag_scope("serve_prefill_chunk", 4), \
+            flag_scope("serve_spec_k", 3):
+        eng = _engine(tiny_model)
+    outs = []
+    for p in oracle["prompts"]:
+        outs.append(eng.generate([p], max_new_tokens=6)[0].tolist())
+    assert outs == oracle["outs"]
+    assert eng.prefix_cache.stats["hit_tokens"] > 0
+    assert eng._stats["prefill_chunks"] > 0
+    assert eng._stats["spec_proposed"] > 0
+    assert eng.cache.allocator.pages_in_use \
+        == eng.prefix_cache.cached_pages      # only the tree holds pages
+    eng.shutdown()
+    assert eng.cache.allocator.pages_in_use == 0
+
+
+def test_flags_off_no_new_series_or_dispatches(tiny_model):
+    """Zero-overhead contract: with all three flags at their defaults
+    the engine adds no prefix/spec/chunk registry series and performs
+    the same dispatch sequence as before ISSUE 15."""
+    with scoped_registry() as reg:
+        eng = _engine(tiny_model)
+        assert eng.prefix_cache is None
+        eng.generate([[5, 6, 7, 8], [9, 10, 11]], max_new_tokens=4)
+        names = set(reg.names())
+        eng.shutdown()
+    assert not any(n.startswith(("serve_prefix_", "serve_spec_"))
+                   or n == "serve_prefill_chunks_total"
+                   for n in names)
+
+
+# ---------------------------------------------------------------------------
+# scheduler fuzz with the prefix cache armed: refcount invariants
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_fuzz_refcount_invariants():
+    """260 random interleavings of submit/admit/decode/finish/cancel/
+    preempt with donation + COW matches live: no page is on the free
+    list while any slot or the tree maps it, refcounts equal the
+    mapping count, writes never start below the shared coverage, and an
+    eviction storm leaks nothing."""
+    cache = _host_cache(num_pages=14, block_size=4, max_slots=3)
+    pc = RadixPrefixCache(cache)
+    cache.prefix_cache = pc
+    sched = Scheduler(cache, BucketTable((8, 16, 24), (1, 2)),
+                      max_queue=32)
+    alloc = cache.allocator
+    rng = np.random.default_rng(777)
+    submitted = []
+    # a few hot prefixes so matches actually occur
+    prefixes = [rng.integers(1, 99, (8,)).tolist() for _ in range(3)]
+
+    def check_invariants():
+        free = list(alloc._free)
+        assert len(free) == len(set(free))
+        mapped = {}
+        for slot, pages in enumerate(cache._slot_pages):
+            for p in pages:
+                mapped[p] = mapped.get(p, 0) + 1
+        for p in pc._nodes:
+            mapped[p] = mapped.get(p, 0) + 1
+        # refcount == number of mappings, for every allocated page
+        assert mapped == dict(alloc._rc)
+        # free list disjoint from every mapping
+        assert not set(mapped) & set(free)
+        assert alloc.pages_in_use == len(mapped)
+        # COW: no slot's prefill cursor sits below its shared coverage
+        for slot, st in ((i, s) for i, s in enumerate(sched.slots)
+                         if s is not None):
+            assert st.prefill_pos >= \
+                cache.slot_shared_blocks(slot) * cache.block_size
+
+    for it in range(260):
+        op = int(rng.integers(0, 7))
+        if op == 0:
+            pre = prefixes[int(rng.integers(0, len(prefixes)))]
+            tail = rng.integers(1, 99,
+                                (int(rng.integers(1, 5)),)).tolist()
+            try:
+                submitted.append(sched.submit(Request(
+                    pre + tail,
+                    max_new_tokens=int(rng.integers(1, 6)))))
+            except Exception:
+                pass
+        elif op == 1:
+            sched.plan_admissions()
+            # simulate the engine's prefill completing instantly
+            for _, st in sched.active():
+                if st.prefilling:
+                    st.prefill_pos = st.prefill_len
+        elif op == 2:
+            sched.ensure_decode_capacity()
+            for _, st in list(sched.active()):
+                if st.prefilling:
+                    continue
+                st.generated.append(int(rng.integers(1, 99)))
+                if st.is_done():
+                    sched.finish(st)
+        elif op == 3 and submitted:
+            st = submitted[int(rng.integers(0, len(submitted)))]
+            sched.cancel(st.request.request_id)
+        elif op == 4:
+            act = sched.active()
+            if act and rng.random() < 0.4:
+                _, st = act[int(rng.integers(0, len(act)))]
+                sched.fail(st, "fuzz")
+        elif op == 5:
+            # eviction pressure
+            pc.evict_for(int(rng.integers(1, 4)))
+        elif op == 6:
+            pool = sched.waiting + [s for _, s in sched.active()]
+            if pool and rng.random() < 0.2:
+                sched.drain_release(
+                    pool[int(rng.integers(0, len(pool)))])
+        check_invariants()
+
+    guard = 0
+    while sched.has_work:
+        sched.plan_admissions()
+        for _, st in sched.active():
+            if st.prefilling:
+                st.prefill_pos = st.prefill_len
+        sched.ensure_decode_capacity()
+        for _, st in list(sched.active()):
+            st.generated.append(1)
+            if st.is_done():
+                sched.finish(st)
+        check_invariants()
+        guard += 1
+        assert guard < 2000
+    # eviction storm drains the tree; nothing leaks
+    pc.evict_for(10_000)
+    check_invariants()
+    assert alloc.pages_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# drain snapshots: chunked progress + in-flight drafts survive
+# ---------------------------------------------------------------------------
+
+
+def test_request_spec_records_chunk_progress_and_drafts():
+    cache = _host_cache()
+    sched = Scheduler(cache, BucketTable((8, 16, 24), (1, 2)))
+    st = sched.submit(Request(list(range(1, 13)), max_new_tokens=4))
+    sched.plan_admissions()
+    st.prefill_pos = 8                 # mid-chunk
+    spec = request_spec(st)
+    assert spec["prefill_pos"] == 8 and spec["draft"] == []
+    st.prefill_pos = st.prefill_len
+    st.generated.append(42)
+    st.draft = [7, 8]
+    spec = request_spec(st)
+    assert spec["draft"] == [7, 8]
+    assert spec["generated"] == [42]   # drafts never count as committed
+    # restore ignores uncommitted drafts: the effective prompt is
+    # prompt+generated only
+    reqs = requests_from_snapshot([spec])
+    assert reqs[0].prompt.tolist() == list(range(1, 13)) + [42]
+    assert reqs[0].max_new_tokens == 3
+
+
+def test_drain_mid_chunk_resumes_token_exact(tiny_model, tmp_path):
+    """SIGTERM mid-chunked-prefill: the snapshot records prefill
+    progress and the backlog re-runs token-exactly on a successor —
+    including through a TORN second commit that must fall back to the
+    valid mid-chunk snapshot (the PR 8 drill extended to ISSUE 15)."""
+    long_p = np.random.default_rng(8).integers(2, 250, (40,)).tolist()
+    golden = _golden(tiny_model, long_p, 4)
+    snap = str(tmp_path / "drain")
+
+    def drain_mid_chunk(torn: bool):
+        with flag_scope("serve_prefill_chunk", 4), \
+                flag_scope("serve_spec_k", 3):
+            eng = _engine(tiny_model, max_context_len=64,
+                          prefill_buckets=(4, 8, 16, 64))
+        st = eng.submit(Request(long_p, max_new_tokens=4))
+        eng.step()
+        eng.step()                      # a couple of chunks in
+        assert st.prefilling and 0 < st.prefill_pos < len(long_p)
+        if torn:
+            with chaos.chaos_scope("ckpt.write.torn@1"):
+                report = eng.drain(snapshot_dir=snap, budget_s=0.0)
+        else:
+            report = eng.drain(snapshot_dir=snap, budget_s=0.0)
+        assert report.snapshotted == 1 and st.outcome == "drained"
+        eng.shutdown()
+        return st
+
+    st1 = drain_mid_chunk(torn=False)
+    drain_mid_chunk(torn=True)          # torn commit of drain_2
+    path, specs = load_drain_snapshot(snap)
+    assert path.endswith("drain_1")     # fell back past the torn dir
+    assert specs and specs[0]["prefill_pos"] == st1.prefill_pos
+    assert specs[0]["generated"] == [] and specs[0]["draft"] == []
+    # successor: plain flags-off engine re-runs the backlog
+    eng2 = _engine(tiny_model, max_context_len=64,
+                   prefill_buckets=(4, 8, 16, 64))
+    [req] = requests_from_snapshot(specs)
+    st2 = eng2.submit(req)
+    eng2.run()
+    out = np.concatenate([req.prompt,
+                          np.asarray(st2.generated, np.int32)])
+    assert np.array_equal(out, golden)
+    eng2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# loadgen chat workload
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_shared_prefix_pool_zipf():
+    spec = LoadSpec(num_requests=40, rate_rps=100.0,
+                    prompt_len_range=(4, 8), seed=3,
+                    shared_prefix_len=12, prefix_pool_size=4,
+                    prefix_zipf=1.3)
+    reqs = [r for _, r in build_requests(spec)]
+    heads = {tuple(r.prompt[:12].tolist()) for r in reqs}
+    assert 1 < len(heads) <= 4                 # pool-sized reuse
+    assert all(r.prompt.size >= 12 + 4 for r in reqs)
+    # deterministic per seed
+    reqs2 = [r for _, r in build_requests(spec)]
+    assert all(np.array_equal(a.prompt, b.prompt)
+               for a, b in zip(reqs, reqs2))
+    # hot head: the most reused prefix dominates (zipf, rank 0)
+    counts = {}
+    for r in reqs:
+        counts[tuple(r.prompt[:12].tolist())] = \
+            counts.get(tuple(r.prompt[:12].tolist()), 0) + 1
+    assert max(counts.values()) >= 40 // 3
+
+
+def test_loadgen_default_spec_byte_identical():
+    """shared_prefix_len=0 (default) draws NOTHING extra: traffic is
+    byte-identical with the feature compiled in."""
+    a = build_requests(LoadSpec(num_requests=12, seed=5))
+    b = build_requests(LoadSpec(num_requests=12, seed=5,
+                                shared_prefix_len=0,
+                                prefix_pool_size=99, prefix_zipf=9.9))
+    for (ta, ra), (tb, rb) in zip(a, b):
+        assert ta == tb and np.array_equal(ra.prompt, rb.prompt)
+        assert ra.max_new_tokens == rb.max_new_tokens
+
+
+# ---------------------------------------------------------------------------
+# observability: report render + phase surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_report_renders_prefix_and_spec_tables(tiny_model,
+                                                       tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import monitor_report
+    with scoped_registry() as reg:
+        with flag_scope("serve_prefix_cache", True), \
+                flag_scope("serve_spec_k", 3):
+            eng = _engine(tiny_model)
+        eng.generate([REP_PROMPT], max_new_tokens=6)
+        eng.generate([REP_PROMPT + [3]], max_new_tokens=4)
+        path = str(tmp_path / "m.jsonl")
+        reg.dump_jsonl(path)
+        eng.shutdown()
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    out = monitor_report.render(rows, serve=True)
+    assert "Prefix cache (radix tree over KV pages)" in out
+    assert "Speculative decoding (n-gram drafts)" in out
+    assert "tokens served from cache" in out
+    assert "% acceptance" in out
+
+
+def test_statusz_slot_phase(tiny_model):
+    with flag_scope("serve_prefill_chunk", 4):
+        eng = _engine(tiny_model, max_context_len=64,
+                      prefill_buckets=(4, 8, 16, 64))
+    long_p = np.random.default_rng(4).integers(2, 250, (32,)).tolist()
+    st = eng.submit(Request(long_p, max_new_tokens=2))
+    eng.step()
+    state = eng.scheduler.state()
+    assert state["slots"][0]["phase"] == "prefilling"
+    assert 0 < state["slots"][0]["prefill_pos"] < 32
+    eng.run()
+    assert st.outcome == "completed"
+    eng.shutdown()

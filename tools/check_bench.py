@@ -50,8 +50,12 @@ _ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%",
 # hit% is a recsys tier hit rate (BENCH_recsys): a drop means the hot
 # set fell out of its tier — a perf cliff even when examples/s survives
 # on a fast host — and a healthy hot tier can sit anywhere in 0-100, so
-# points, not ratios, are the meaningful band.
-_ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%"}
+# points, not ratios, are the meaningful band. accept% is the
+# speculative-decoding draft acceptance rate (BENCH_serve,
+# serve_spec_accept_pct): a drop means drafts stopped matching the
+# verifier and every verify dispatch degrades toward a plain decode
+# step — the same anywhere-in-0-100 shape as hit%, so absolute points.
+_ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%", "accept%"}
 # recsys rate-like units (BENCH_recsys) ride the default direction:
 # examples/s (training/serving throughput) and ratio (dedup ratio —
 # mean ids served per row fetched, >= 1) are higher-is-better relative,

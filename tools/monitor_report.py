@@ -476,6 +476,67 @@ def _serve_outcomes(latest, used) -> List[str]:
                   rows)
 
 
+def _prefix_cache_section(latest, used) -> List[str]:
+    """Radix prefix cache (ISSUE 15): occupancy, hit/miss/evict
+    counters and the token-level hit share — the 'is chat traffic
+    actually sharing prefixes' panel next to the outcome table."""
+    vals = {}
+    for key, row in latest.items():
+        name, _ = key
+        if name in ("serve_prefix_cached_pages",
+                    "serve_prefix_hits_total",
+                    "serve_prefix_misses_total",
+                    "serve_prefix_hit_tokens_total",
+                    "serve_prefix_evicted_pages_total"):
+            used.add(key)
+            vals[name] = row.get("value", 0.0)
+    if not vals:
+        return []
+    hits = vals.get("serve_prefix_hits_total", 0.0)
+    misses = vals.get("serve_prefix_misses_total", 0.0)
+    lookups = hits + misses
+    rows = [
+        ["cached pages", f"{vals.get('serve_prefix_cached_pages', 0):g}"],
+        ["admission hits", f"{hits:g}"
+         + (f"  ({100.0 * hits / lookups:.1f}% of lookups)"
+            if lookups else "")],
+        ["admission misses", f"{misses:g}"],
+        ["tokens served from cache",
+         f"{vals.get('serve_prefix_hit_tokens_total', 0):g}"],
+        ["pages evicted",
+         f"{vals.get('serve_prefix_evicted_pages_total', 0):g}"],
+    ]
+    return _table("Prefix cache (radix tree over KV pages)",
+                  ["stat", "value"], rows)
+
+
+def _spec_decode_section(latest, used) -> List[str]:
+    """Speculative decoding (ISSUE 15): proposed/accepted/rolled-back
+    draft counters and the acceptance rate — accepted tokens rode a
+    shared verify dispatch instead of their own decode step."""
+    vals = {}
+    for key, row in latest.items():
+        name, _ = key
+        if name in ("serve_spec_proposed_total",
+                    "serve_spec_accepted_total",
+                    "serve_spec_rolled_back_total"):
+            used.add(key)
+            vals[name] = row.get("value", 0.0)
+    if not vals:
+        return []
+    prop = vals.get("serve_spec_proposed_total", 0.0)
+    acc = vals.get("serve_spec_accepted_total", 0.0)
+    rows = [
+        ["drafts proposed", f"{prop:g}"],
+        ["drafts accepted", f"{acc:g}"
+         + (f"  ({100.0 * acc / prop:.1f}% acceptance)" if prop else "")],
+        ["drafts rolled back",
+         f"{vals.get('serve_spec_rolled_back_total', 0):g}"],
+    ]
+    return _table("Speculative decoding (n-gram drafts)",
+                  ["stat", "value"], rows)
+
+
 def _overload_timeline(rows: List[dict], used) -> List[str]:
     """Overload-state timeline from EVERY serve_overload sample in the
     (append-only) dump, in file order — each registry dump contributes
@@ -523,6 +584,8 @@ def _serve_section(latest, used, raw_rows: Optional[List[dict]] = None) \
                  ["series", "labels", "count", "mean ms", "~p50 ms",
                   "~p99 ms"], lat_rows)
     out += _serve_outcomes(latest, used)
+    out += _prefix_cache_section(latest, used)
+    out += _spec_decode_section(latest, used)
     out += _overload_timeline(raw_rows or [], used)
     occ_rows, g_rows, c_rows, prog_rows = [], [], [], []
     for key in sorted(latest):
