@@ -628,7 +628,9 @@ def bench_serve(quick: bool = False) -> list:
         "attached vs without, same engine)")
     throughput_lines = serve_throughput_features(model, name, serve_cfg,
                                                  quick=quick)
-    return throughput_lines + [
+    fleet_lines = serve_fleet_metrics(model, name, serve_cfg,
+                                      quick=quick)
+    return throughput_lines + fleet_lines + [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
                     vs_baseline=1.0,
@@ -775,6 +777,133 @@ def serve_throughput_features(model, name, serve_cfg, quick: bool) -> list:
         metric_line("serve_ttft_p99_ms", ttft99_on, "ms",
                     vs_baseline=1.0,
                     vs_flags_off_ms=round(ttft99_off, 1)),
+    ]
+
+
+def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
+    """ISSUE 16 legs: the tenanted shared-prefix workload served once by
+    a single replica and once by an N-replica fleet behind the
+    prefix-affine :class:`~paddle_tpu.serving.FleetRouter`, both on the
+    SAME seed. Records ``serve_fleet_tokens_per_sec`` (aggregate, the
+    per-host busy-time model), ``serve_fleet_scaling_eff_pct``
+    (aggregate vs N x single-replica, weak-scaling points),
+    ``serve_fleet_prefix_hit_pct`` (affinity must keep fleet hit%
+    within a few points of one engine) and
+    ``serve_router_overhead_p99_ms`` (route-decision latency) — and
+    REFUSES to record unless the fleet's greedy outputs are
+    token-identical to a single engine's (router parity is an oracle
+    pin, same contract as the feature legs above)."""
+    import dataclasses
+
+    import numpy as np
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.serving import (FleetRouter, LoadSpec, RouterConfig,
+                                    SamplingParams, ServingEngine,
+                                    run_fleet_open_loop)
+
+    n_fleet = 2 if quick else 4
+    if quick:
+        rep_cfg = dataclasses.replace(serve_cfg)
+        # load heavy enough that EACH fleet replica keeps its batch
+        # slots occupied (otherwise the leg measures batching occupancy
+        # loss, not router scaling), with enough distinct tenants that
+        # the affinity keys hash-spread across the ring
+        fleet_spec = LoadSpec(num_requests=48, rate_rps=240.0,
+                              prompt_len_range=(4, 12),
+                              max_new_range=(6, 12),
+                              vocab_size=model.cfg.vocab_size, seed=13,
+                              sampling=SamplingParams(),
+                              shared_prefix_len=16, prefix_pool_size=4,
+                              prefix_zipf=1.05, tenants=16)
+    else:
+        # smaller per-replica footprint than the single-engine bench:
+        # four 345M KV pools at max_context 512 would measure the
+        # host's allocator, not the router
+        rep_cfg = dataclasses.replace(serve_cfg, max_batch_slots=4,
+                                      max_context_len=256)
+        fleet_spec = LoadSpec(num_requests=48, rate_rps=24.0,
+                              prompt_len_range=(16, 64),
+                              max_new_range=(8, 24),
+                              vocab_size=model.cfg.vocab_size, seed=13,
+                              sampling=SamplingParams(),
+                              shared_prefix_len=64, prefix_pool_size=4,
+                              prefix_zipf=1.05, tenants=16)
+    rng = np.random.default_rng(11)
+    pre = rng.integers(0, model.cfg.vocab_size, (16,)).tolist()
+    parity_prompts = [pre + rng.integers(0, model.cfg.vocab_size,
+                                         (6,)).tolist(),
+                      pre + rng.integers(0, model.cfg.vocab_size,
+                                         (4,)).tolist(),
+                      [3, 4, 5, 3, 4, 5, 3, 4]]
+
+    def build_fleet(n):
+        # prefix cache ON in every replica (kill-switch flags read at
+        # engine init), so fleet hit% measures affinity, not a cold
+        # cache
+        with flag_scope("serve_prefix_cache", True):
+            reps = {}
+            for i in range(n):
+                eng = ServingEngine(model, dataclasses.replace(rep_cfg))
+                eng.warmup()
+                reps[f"r{i}"] = eng
+            # saturation threshold above the default: the bench drives
+            # a deliberate overload burst, and spilling every queued
+            # request off its affinity replica would measure p2c, not
+            # the prefix-affine design point (p2c has its own tests)
+            return FleetRouter(reps, RouterConfig(
+                seed=3, saturation_queue_depth=12))
+
+    def phase(n):
+        router = build_fleet(n)
+        try:
+            # measured window FIRST — run_fleet_open_loop's summary is
+            # cumulative, and the parity prompts are deliberately
+            # affinity-skewed (shared prefix → one replica), which
+            # would poison the busy-time scaling accounting. Greedy
+            # parity is cache-state-independent, so gating after the
+            # measured run checks the same thing.
+            summary = run_fleet_open_loop(router, fleet_spec)
+            outs = [o[-8:].tolist() for o in router.generate(
+                parity_prompts, max_new_tokens=8)]
+        finally:
+            router.shutdown()
+        return summary, outs
+
+    s_one, outs_one = phase(1)
+    s_fleet, outs_fleet = phase(n_fleet)
+    if outs_fleet != outs_one:
+        log("serve[fleet]: PARITY FAILURE — fleet-routed greedy "
+            "outputs diverge from the single-engine oracle; refusing "
+            "to record the fleet legs")
+        log(f"  single: {outs_one}\n  fleet:  {outs_fleet}")
+        return []
+    single_tps = max(s_one["aggregate_tokens_per_sec"], 1e-9)
+    agg = s_fleet["aggregate_tokens_per_sec"]
+    eff = 100.0 * agg / (n_fleet * single_tps)
+    p99_ms = s_fleet["route_overhead_p99_s"] * 1e3
+    log(f"serve[fleet/{name}]: {n_fleet} replicas on seed "
+        f"{fleet_spec.seed}: aggregate {agg:.1f} tok/s vs single "
+        f"{single_tps:.1f} ({eff:.1f}% weak-scaling eff), fleet "
+        f"prefix hit {s_fleet['fleet_prefix_hit_pct']:.1f}% vs single "
+        f"{s_one['fleet_prefix_hit_pct']:.1f}%, routed "
+        f"{s_fleet['routed_affine']} affine / "
+        f"{s_fleet['routed_balanced']} balanced, route p99 "
+        f"{p99_ms:.2f} ms, availability "
+        f"{s_fleet['availability_pct']:.1f}%, greedy outputs "
+        "token-identical to the single-engine oracle")
+    return [
+        metric_line("serve_fleet_tokens_per_sec", agg, "tokens/s",
+                    vs_baseline=1.0, replicas=n_fleet),
+        metric_line("serve_fleet_scaling_eff_pct", eff, "weak%",
+                    vs_baseline=1.0),
+        metric_line("serve_fleet_prefix_hit_pct",
+                    s_fleet["fleet_prefix_hit_pct"], "hit%",
+                    vs_baseline=1.0,
+                    vs_single=round(s_one["fleet_prefix_hit_pct"], 1)),
+        metric_line("serve_router_overhead_p99_ms", p99_ms, "ms",
+                    vs_baseline=1.0),
+        metric_line("serve_fleet_availability_pct",
+                    s_fleet["availability_pct"], "%", vs_baseline=1.0),
     ]
 
 

@@ -251,9 +251,10 @@ define_flag("serve_spec_k", 0,
             "the paged cache; the accepted prefix plus one bonus token "
             "commit, rejected tails roll back by block-table truncation. "
             "Greedy output is token-identical to the non-speculative "
-            "path (pinned); sampled slots fall back to single-token "
-            "decode rows. 0 (default) = one decode dispatch per token, "
-            "bit-compatible. Read at ServingEngine construction.")
+            "path (pinned); sampled slots run stochastic residual "
+            "accept/reject (ISSUE 16), distribution-identical to plain "
+            "sampled decode. 0 (default) = one decode dispatch per "
+            "token, bit-compatible. Read at ServingEngine construction.")
 define_flag("serve_spec_ngram", 3,
             "Longest suffix n-gram the speculative drafter matches "
             "against the request's own prompt+generated history "

@@ -20,7 +20,8 @@ from . import env
 
 __all__ = ["make_mesh", "shard_map", "named_sharding", "current_mesh",
            "PartitionSpec", "apply_param_shardings", "constrain", "BATCH",
-           "data_axes", "degrade_spec"]
+           "data_axes", "degrade_spec", "SERVE_KV_SPEC",
+           "shard_serving_cache"]
 
 PartitionSpec = P
 
@@ -165,6 +166,29 @@ def apply_hybrid_specs(layer, mp_axis: str = "mp"):
         else:
             p.spec = P()
     return layer
+
+
+#: layout of a serving paged K/V pool ``[L, P, bs, H, D]`` under tensor
+#: parallelism (ISSUE 16): heads shard over the mp axis — the same split
+#: apply_hybrid_specs gives the q/k/v projections, so the TP decode
+#: program reads/writes its local head shard without any gather. Layers,
+#: pages and the per-page token dim stay replicated (page tables index
+#: them host-side).
+SERVE_KV_SPEC = P(None, None, None, "mp", None)
+
+
+def shard_serving_cache(cache, mesh: Mesh):
+    """Lay a serving PagedKVCache's pools out on the TP mesh (heads over
+    ``mp`` per :data:`SERVE_KV_SPEC`, degraded for meshes without an mp
+    axis). Called once at engine init, before the first AOT compile, so
+    the serving programs see sharded donors and GSPMD keeps the pools
+    resident in the split layout — per-chip HBM then holds ``1/mp`` of
+    the KV footprint, which is what lets models beyond single-chip HBM
+    serve at all."""
+    sh = NamedSharding(mesh, degrade_spec(SERVE_KV_SPEC, mesh))
+    cache.k = jax.device_put(cache.k, sh)
+    cache.v = jax.device_put(cache.v, sh)
+    return cache
 
 
 def shard_map(body, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):

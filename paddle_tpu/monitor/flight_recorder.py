@@ -60,7 +60,8 @@ RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
                    "collective_timeout", "nonfinite_skip", "preempted",
                    "trip", "chaos", "request_failed", "request_expired",
                    "request_cancelled", "request_drained", "request_shed",
-                   "decode_watchdog", "overload", "drained")
+                   "decode_watchdog", "overload", "drained",
+                   "replica_migration")
 
 
 # dump-time attachment hooks: other forensic subsystems (the structured

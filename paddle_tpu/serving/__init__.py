@@ -18,10 +18,13 @@ literature mapped onto static-shape XLA programs:
   streaming per-token callbacks and TTFT/TPOT/throughput metrics into
   the :mod:`paddle_tpu.monitor` registry;
 - :mod:`.loadgen` is the synthetic open-loop driver behind
-  ``bench.py --serve`` (the ``BENCH_serve`` record).
+  ``bench.py --serve`` (the ``BENCH_serve`` record);
+- :mod:`.router` scales one engine to a fleet (ISSUE 16): a
+  prefix-affine front-end over N replicas with telemetry-driven load
+  balancing and chaos-proof drain/death migration.
 
-See docs/SERVING.md for architecture, bucketing policy and the flag
-matrix.
+See docs/SERVING.md for architecture, bucketing policy, the flag
+matrix and the fleet topology.
 """
 
 from .detok import StreamingDetokenizer  # noqa: F401
@@ -32,12 +35,14 @@ from .kv_cache import (BlockAllocator, ContextPagedCacheView,  # noqa: F401
 from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .spec_decode import propose_ngram  # noqa: F401
 from .loadgen import (LoadSpec, TokenBucket, build_requests,  # noqa: F401
-                      run_open_loop)
+                      run_fleet_open_loop, run_open_loop)
+from .router import FleetRouter, ReplicaHandle, RouterConfig  # noqa: F401
 from .resilience import (DecodeWatchdogError, DrainLatch,  # noqa: F401
                          DrainReport, EngineDrained, OverloadDetector,
                          ServerOverloaded, load_drain_snapshot,
                          requests_from_snapshot, save_drain_snapshot)
-from .sampling import SamplingParams, sample_tokens  # noqa: F401
+from .sampling import (SamplingParams, filtered_logits,  # noqa: F401
+                       sample_tokens)
 from .scheduler import (TERMINAL_OUTCOMES, BucketTable,  # noqa: F401
                         Request, Scheduler)
 
@@ -51,7 +56,8 @@ __all__ = [
     "save_drain_snapshot", "load_drain_snapshot",
     "requests_from_snapshot", "TERMINAL_OUTCOMES", "reset",
     "RadixPrefixCache", "propose_ngram", "ContextPagedCacheView",
-    "ContextPagedLayerCache",
+    "ContextPagedLayerCache", "FleetRouter", "ReplicaHandle",
+    "RouterConfig", "run_fleet_open_loop", "filtered_logits",
 ]
 
 

@@ -31,6 +31,7 @@ The execution model (ISSUE 6; docs/SERVING.md):
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import weakref
@@ -56,7 +57,8 @@ from .resilience import (DecodeWatchdogError, DispatchWorker, DrainLatch,
                          DrainReport, EngineDrained, OverloadDetector,
                          ServerOverloaded, request_spec,
                          save_drain_snapshot)
-from .sampling import SamplingParams, sample_tokens
+from .sampling import (SamplingParams, _NEG as _SAMPLING_NEG,
+                       filtered_logits, sample_tokens)
 from .scheduler import (QUEUE_POLICIES, AdmissionGroup, BucketTable,
                         Request, RequestState, Scheduler)
 
@@ -64,6 +66,10 @@ __all__ = ["ServingConfig", "ServingEngine"]
 
 #: live engines, for test isolation (serving.reset shuts them down)
 _LIVE_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+
+#: trace/compile serialization across engines (see _mesh_scope): the
+#: fleet router's per-replica serve threads must not trace concurrently
+_COMPILE_LOCK = threading.Lock()
 
 
 def _pow2_buckets(lo: int, hi: int) -> Tuple[int, ...]:
@@ -118,6 +124,15 @@ class ServingConfig:
     #: where drain snapshots commit (drain_<n> dirs); None = drain()
     #: refuses to discard pending work
     drain_dir: Optional[str] = None
+    #: tensor-parallel serving mesh (ISSUE 16): a jax Mesh whose ``mp``
+    #: axis shards attention heads / MLP width across chips. The serving
+    #: signatures compile under it (collectives live INSIDE the
+    #: executables, via the model's Megatron specs + GSPMD) and the
+    #: paged K/V pools shard over the heads dim
+    #: (distributed.spmd.SERVE_KV_SPEC) — per-chip HBM holds 1/mp of
+    #: params and KV, which is what serves models beyond one chip.
+    #: None (default) = single-chip engine, bit-compatible.
+    mesh: Optional[object] = None
 
     def resolve(self, model_max_positions: Optional[int]) -> None:
         if self.queue_policy not in QUEUE_POLICIES:
@@ -165,6 +180,22 @@ class ServingEngine:
         self.config.resolve(getattr(cfg, "max_position_embeddings", None))
         self.clock = clock
         model.eval()
+        self.mesh = self.config.mesh
+        if self.mesh is not None:
+            # TP-sharded serving (ISSUE 16): stamp Megatron specs on any
+            # params still unplaced and lay the model out on the mesh
+            # BEFORE param_arrays snapshots it, so every AOT serving
+            # program compiles against sharded donors and GSPMD bakes
+            # the collectives into the executables.
+            from ..distributed.spmd import (apply_hybrid_specs,
+                                            apply_param_shardings)
+            mp = dict(self.mesh.shape).get("mp", 1)
+            if cfg.num_heads % mp:
+                raise ValueError(
+                    f"model num_heads={cfg.num_heads} not divisible by "
+                    f"mesh mp={mp}; TP serving shards KV over heads")
+            apply_hybrid_specs(model)
+            apply_param_shardings(model, self.mesh)
         self.params = param_arrays(model)
         self.buffers = buffer_arrays(model)
         c = self.config
@@ -175,6 +206,9 @@ class ServingEngine:
             max_blocks_per_slot=blocks_needed(c.max_context_len,
                                               c.block_size),
             dtype=jnp.dtype(c.cache_dtype))
+        if self.mesh is not None:
+            from ..distributed.spmd import shard_serving_cache
+            shard_serving_cache(self.cache, self.mesh)
         self.buckets = BucketTable(c.prefill_buckets, c.batch_buckets)
         self.scheduler = Scheduler(self.cache, self.buckets,
                                    max_queue=c.max_queue, clock=clock,
@@ -217,6 +251,10 @@ class ServingEngine:
         self._programs: Dict[tuple, AOTProgram] = {}
         self._programs_info: Dict[str, dict] = {}
         self._key = jax.random.key(int(c.seed))
+        #: host-side accept/reject coin for stochastic speculative
+        #: sampling (ISSUE 16) — its own stream so spec on/off never
+        #: perturbs the device RNG the flags-off oracle pins
+        self._spec_rng = np.random.default_rng((int(c.seed) << 1) ^ 0x51EC)
         self._dispatch_seq = 0
         self._stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
                        "decode_slot_steps": 0, "decode_batch_max": 0,
@@ -322,6 +360,31 @@ class ServingEngine:
         self._dispatch_seq += 1
         return jax.random.fold_in(self._key, self._dispatch_seq)
 
+    @contextlib.contextmanager
+    def _mesh_scope(self):
+        """Activate the TP serving mesh for the dynamic extent of a
+        program trace/compile: ``constrain()`` pins inside the model
+        read the active mesh at TRACE time, so without this scope a
+        TP engine's programs would silently compile unsharded. Dispatch
+        needs no scope — the compiled executables embed their shardings.
+
+        Also serializes traces process-wide: the fleet router drives
+        one serve thread per replica, and two replicas lazily compiling
+        at once would race on the global mesh (and on trace-time global
+        state generally). The lock is only ever taken on a compile
+        miss, never on the dispatch path."""
+        with _COMPILE_LOCK:
+            if self.mesh is None:
+                yield
+                return
+            from ..distributed import env as dist_env
+            prev = dist_env.get_mesh()
+            dist_env.set_mesh(self.mesh)
+            try:
+                yield
+            finally:
+                dist_env.set_mesh(prev)
+
     def _fwd(self, params, ids, k, v, table, pos, ctx: bool = False):
         """Pure model forward over the paged view (traced inside the
         prefill/decode programs). ``ctx=True`` selects the
@@ -406,15 +469,16 @@ class ServingEngine:
         prog = AOTProgram("serve_decode", decode_fn,
                           donate_argnums=self._donate(),
                           on_attribute=self._attribute)
-        prog.compile((self.params, self.cache.k, self.cache.v,
-                      jnp.zeros((B, mb), jnp.int32),
-                      jnp.zeros((B,), jnp.int32),
-                      jnp.zeros((B,), jnp.int32),
-                      jnp.zeros((B,), bool), self._key,
-                      jnp.ones((B,), jnp.float32),
-                      jnp.zeros((B,), jnp.int32),
-                      jnp.ones((B,), jnp.float32),
-                      jnp.zeros((B,), jnp.float32)))
+        with self._mesh_scope():
+            prog.compile((self.params, self.cache.k, self.cache.v,
+                          jnp.zeros((B, mb), jnp.int32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((B,), bool), self._key,
+                          jnp.ones((B,), jnp.float32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.ones((B,), jnp.float32),
+                          jnp.zeros((B,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -440,14 +504,15 @@ class ServingEngine:
         prog = AOTProgram(f"serve_prefill_b{nb}_s{sp}", prefill_fn,
                           donate_argnums=self._donate(),
                           on_attribute=self._attribute)
-        prog.compile((self.params, self.cache.k, self.cache.v,
-                      jnp.zeros((nb, mb), jnp.int32),
-                      jnp.zeros((nb, sp), jnp.int32),
-                      jnp.ones((nb,), jnp.int32), self._key,
-                      jnp.ones((nb,), jnp.float32),
-                      jnp.zeros((nb,), jnp.int32),
-                      jnp.ones((nb,), jnp.float32),
-                      jnp.zeros((nb,), jnp.float32)))
+        with self._mesh_scope():
+            prog.compile((self.params, self.cache.k, self.cache.v,
+                          jnp.zeros((nb, mb), jnp.int32),
+                          jnp.zeros((nb, sp), jnp.int32),
+                          jnp.ones((nb,), jnp.int32), self._key,
+                          jnp.ones((nb,), jnp.float32),
+                          jnp.zeros((nb,), jnp.int32),
+                          jnp.ones((nb,), jnp.float32),
+                          jnp.zeros((nb,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -479,15 +544,16 @@ class ServingEngine:
                           prefill_ctx_fn,
                           donate_argnums=self._donate(),
                           on_attribute=self._attribute)
-        prog.compile((self.params, self.cache.k, self.cache.v,
-                      jnp.zeros((nb, mb), jnp.int32),
-                      jnp.zeros((nb, sp), jnp.int32),
-                      jnp.ones((nb,), jnp.int32),
-                      jnp.zeros((nb,), jnp.int32), self._key,
-                      jnp.ones((nb,), jnp.float32),
-                      jnp.zeros((nb,), jnp.int32),
-                      jnp.ones((nb,), jnp.float32),
-                      jnp.zeros((nb,), jnp.float32)))
+        with self._mesh_scope():
+            prog.compile((self.params, self.cache.k, self.cache.v,
+                          jnp.zeros((nb, mb), jnp.int32),
+                          jnp.zeros((nb, sp), jnp.int32),
+                          jnp.ones((nb,), jnp.int32),
+                          jnp.zeros((nb,), jnp.int32), self._key,
+                          jnp.ones((nb,), jnp.float32),
+                          jnp.zeros((nb,), jnp.int32),
+                          jnp.ones((nb,), jnp.float32),
+                          jnp.zeros((nb,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -499,7 +565,14 @@ class ServingEngine:
         greedy argmaxes for draft acceptance, and per-row finite flags
         (fault isolation stays per-slot AND per-used-row — pad rows
         beyond a slot's draft may read scratch garbage and are never
-        consulted)."""
+        consulted). For sampled slots (ISSUE 16) it additionally
+        returns the residual accept/reject ingredients — the drafted
+        token's probability under each row's FILTERED sampling
+        distribution, a full fresh sample per row (bonus token on a
+        clean sweep), and a residual redraw per row with the draft
+        masked out — so the host can run point-mass-drafter
+        Leviathan-style acceptance and the committed stream keeps the
+        plain sampled-decode distribution exactly."""
         key = ("verify", self._spec_k + 1)
         prog = self._programs.get(key)
         if prog is not None:
@@ -516,22 +589,44 @@ class ServingEngine:
                 jnp.isfinite(row0).all(axis=-1))
             tok0 = sample_tokens(row0, rng, temps, top_ks, top_ps)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jnp.where(active, tok0, 0), greedy, ok_rows, k, v
+            B, V = logits.shape[0], logits.shape[-1]
+            flat = filtered_logits(
+                logits.reshape(B * S, V).astype(jnp.float32),
+                jnp.repeat(temps, S), jnp.repeat(top_ks, S),
+                jnp.repeat(top_ps, S)).reshape(B, S, V)
+            probs = jax.nn.softmax(flat, axis=-1)
+            drafts = ids[:, 1:]                               # [B,S-1]
+            # p_draft[b, i] = P(draft_i | rows 0..i) — row i's filtered
+            # softmax mass on the token the drafter proposed for it
+            p_draft = jnp.take_along_axis(
+                probs[:, :-1, :], drafts[..., None],
+                axis=-1)[..., 0]                              # [B,S-1]
+            k_full, k_resid = jax.random.split(jax.random.fold_in(rng, 1))
+            tok_full = jax.random.categorical(
+                k_full, flat, axis=-1).astype(jnp.int32)      # [B,S]
+            resid = jnp.where(
+                jax.nn.one_hot(drafts, V, dtype=bool),
+                _SAMPLING_NEG, flat[:, :-1, :])
+            tok_resid = jax.random.categorical(
+                k_resid, resid, axis=-1).astype(jnp.int32)    # [B,S-1]
+            return (jnp.where(active, tok0, 0), greedy, ok_rows,
+                    p_draft, tok_full, tok_resid, k, v)
 
         B = self.config.max_batch_slots
         mb = self.cache.max_blocks_per_slot
         prog = AOTProgram(f"serve_verify_s{S}", verify_fn,
                           donate_argnums=self._donate(),
                           on_attribute=self._attribute)
-        prog.compile((self.params, self.cache.k, self.cache.v,
-                      jnp.zeros((B, mb), jnp.int32),
-                      jnp.zeros((B,), jnp.int32),
-                      jnp.zeros((B, S), jnp.int32),
-                      jnp.zeros((B,), bool), self._key,
-                      jnp.ones((B,), jnp.float32),
-                      jnp.zeros((B,), jnp.int32),
-                      jnp.ones((B,), jnp.float32),
-                      jnp.zeros((B,), jnp.float32)))
+        with self._mesh_scope():
+            prog.compile((self.params, self.cache.k, self.cache.v,
+                          jnp.zeros((B, mb), jnp.int32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((B, S), jnp.int32),
+                          jnp.zeros((B,), bool), self._key,
+                          jnp.ones((B,), jnp.float32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.ones((B,), jnp.float32),
+                          jnp.zeros((B,), jnp.float32)))
         self._programs[key] = prog
         return prog
 
@@ -1153,17 +1248,18 @@ class ServingEngine:
 
     def _stage_drafts(self) -> None:
         """Prompt-lookup drafting (ISSUE 15): propose up to ``k`` draft
-        tokens per GREEDY decodable slot from its own history. Zero
-        drafts everywhere ⇒ the iteration falls through to the plain
-        decode program — the drafter costs nothing when traffic has no
-        self-repetition."""
+        tokens per decodable slot from its own history — greedy slots
+        verify by argmax match, sampled slots by stochastic residual
+        acceptance (ISSUE 16). Zero drafts everywhere ⇒ the iteration
+        falls through to the plain decode program — the drafter costs
+        nothing when traffic has no self-repetition."""
         from .spec_decode import propose_ngram
         proposed = 0
         for _, st in self._decodable():
             st.draft = []
             budget = min(self._spec_k, st.remaining_new_tokens() - 1)
-            if budget <= 0 or st.request.sampling.temperature > 0:
-                continue            # sampled slots decode via row 0
+            if budget <= 0:
+                continue
             hist = np.concatenate([
                 st.request.prompt,
                 np.asarray(st.generated, np.int32)])
@@ -1201,17 +1297,21 @@ class ServingEngine:
         prog = self._get_verify()
         temps, tks, tps = self._sampling_arrays(per_slot)
         hang = chaos.active() and chaos.probe("serve.decode.hang")
-        tok0, greedy, ok_rows, new_k, new_v = self._guarded_dispatch(
-            "verify", prog,
-            (self.params, self.cache.k, self.cache.v,
-             self._decode_table(per_slot), jnp.asarray(pos),
-             jnp.asarray(ids), jnp.asarray(active), self._next_key(),
-             temps, tks, tps, self._poison_array(per_slot)),
-            hang=hang)
+        tok0, greedy, ok_rows, p_draft, tok_full, tok_resid, new_k, \
+            new_v = self._guarded_dispatch(
+                "verify", prog,
+                (self.params, self.cache.k, self.cache.v,
+                 self._decode_table(per_slot), jnp.asarray(pos),
+                 jnp.asarray(ids), jnp.asarray(active), self._next_key(),
+                 temps, tks, tps, self._poison_array(per_slot)),
+                hang=hang)
         self.cache.update(new_k, new_v)
         tok0 = np.asarray(tok0)
         greedy = np.asarray(greedy)
         ok_rows = np.asarray(ok_rows)
+        p_draft = np.asarray(p_draft)
+        tok_full = np.asarray(tok_full)
+        tok_resid = np.asarray(tok_resid)
         now = self.clock()
         dt = now - t0
         st_ = self._stats
@@ -1240,23 +1340,50 @@ class ServingEngine:
                 st.draft = []
                 self.scheduler.fail(st, "non-finite logits at decode")
                 continue
-            # greedy acceptance: draft i survives iff it equals the
-            # verifier's argmax at the previous row AND that row's
-            # logits are finite (pad/garbage rows never commit)
-            n_acc = 0
-            while n_acc < n and ok_rows[slot, n_acc] \
-                    and st.draft[n_acc] == int(greedy[slot, n_acc]):
-                n_acc += 1
-            commit = [int(tok0[slot])] + \
-                [int(greedy[slot, i]) for i in range(1, n_acc + 1)
-                 if ok_rows[slot, i]]
+            sampled = st.request.sampling.temperature > 0.0
+            if not sampled:
+                # greedy acceptance: draft i survives iff it equals the
+                # verifier's argmax at the previous row AND that row's
+                # logits are finite (pad/garbage rows never commit)
+                n_acc = 0
+                while n_acc < n and ok_rows[slot, n_acc] \
+                        and st.draft[n_acc] == int(greedy[slot, n_acc]):
+                    n_acc += 1
+                commit = [int(tok0[slot])] + \
+                    [int(greedy[slot, i]) for i in range(1, n_acc + 1)
+                     if ok_rows[slot, i]]
+            else:
+                # stochastic acceptance (ISSUE 16), point-mass drafter:
+                # accept draft i with probability p_i(d_i) under row i's
+                # filtered sampling distribution; on reject commit the
+                # device's residual redraw (row i with d_i masked out)
+                # and stop; on a clean sweep commit the bonus sample
+                # from row n. Marginally identical to plain sampled
+                # decode at every committed position.
+                commit = []
+                n_acc = 0
+                for i in range(n):
+                    if not ok_rows[slot, i]:
+                        break
+                    if self._spec_rng.random() < float(p_draft[slot, i]):
+                        commit.append(int(st.draft[i]))
+                        n_acc += 1
+                    else:
+                        commit.append(int(tok_resid[slot, i]))
+                        break
+                else:
+                    if n == 0:
+                        commit.append(int(tok0[slot]))
+                    elif ok_rows[slot, n]:
+                        commit.append(int(tok_full[slot, n]))
             committed = 0
             for t in commit:
                 self._accept_token(st, t, now)
                 committed += 1
                 if st.terminal or st.is_done():
                     break
-            acc = max(0, committed - 1)
+            acc = min(n_acc, committed) if sampled \
+                else max(0, committed - 1)
             accepted += acc
             rolled_back += n - acc
             st.draft = []

@@ -47,7 +47,8 @@ from .resilience import DecodeWatchdogError, ServerOverloaded
 from .sampling import SamplingParams
 from .scheduler import Request
 
-__all__ = ["LoadSpec", "TokenBucket", "build_requests", "run_open_loop"]
+__all__ = ["LoadSpec", "TokenBucket", "build_requests",
+           "run_fleet_open_loop", "run_open_loop"]
 
 _ARRIVALS = ("poisson", "gamma", "mmpp")
 
@@ -88,6 +89,16 @@ class LoadSpec:
     prefix_pool_size: int = 8
     #: zipf exponent of prefix reuse (rank==index; higher = hotter head)
     prefix_zipf: float = 1.1
+    #: fleet workload (ISSUE 16): > 0 = every request belongs to one of
+    #: this many tenants, drawn zipf(``prefix_zipf``) per request, and
+    #: each tenant owns its OWN prefix pool (``prefix_pool_size``
+    #: prefixes of ``shared_prefix_len`` tokens, per-tenant seeded) —
+    #: the traffic shape prefix-affine routing exists for: a tenant's
+    #: whole prefix family hashes to one replica, so its radix tree
+    #: stays hot there. Requires ``shared_prefix_len > 0``. 0 (default)
+    #: = the single shared pool above, byte-identical to pre-fleet
+    #: specs.
+    tenants: int = 0
 
 
 class TokenBucket:
@@ -161,7 +172,29 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
     lo_p, hi_p = spec.prompt_len_range
     lo_n, hi_n = spec.max_new_range
     prefixes = prefix_cdf = None
-    if spec.shared_prefix_len > 0:
+    tenant_pools = tenant_cdf = None
+
+    def _zipf_cdf(n: int) -> np.ndarray:
+        w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64),
+                           float(spec.prefix_zipf))
+        return np.cumsum(w / w.sum())
+
+    if spec.shared_prefix_len > 0 and spec.tenants > 0:
+        # per-tenant prefix pools (ISSUE 16): tenant t's pool comes from
+        # its own fixed-seed side generator, so pools are disjoint and
+        # stable per seed, and — like the single-pool path — none of
+        # the default draws below are perturbed by building them
+        tenant_pools = []
+        for t in range(spec.tenants):
+            prng = np.random.default_rng(
+                spec.seed ^ 0x5A5A ^ (0x1000 * (t + 1)))
+            tenant_pools.append(prng.integers(
+                0, spec.vocab_size,
+                (max(1, spec.prefix_pool_size), spec.shared_prefix_len)
+            ).astype(np.int32))
+        tenant_cdf = _zipf_cdf(spec.tenants)
+        prefix_cdf = _zipf_cdf(tenant_pools[0].shape[0])
+    elif spec.shared_prefix_len > 0:
         # the prefix pool and its zipf CDF draw from a fixed-seed side
         # generator, so enabling prefixes perturbs NOTHING about the
         # default draws below (arrivals/lengths/tails replay exactly)
@@ -170,14 +203,17 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
             0, spec.vocab_size,
             (max(1, spec.prefix_pool_size), spec.shared_prefix_len)
         ).astype(np.int32)
-        w = 1.0 / np.power(
-            np.arange(1, prefixes.shape[0] + 1, dtype=np.float64),
-            float(spec.prefix_zipf))
-        prefix_cdf = np.cumsum(w / w.sum())
+        prefix_cdf = _zipf_cdf(prefixes.shape[0])
     for i in range(spec.num_requests):
         plen = int(rng.integers(lo_p, hi_p + 1))
         prompt = rng.integers(0, spec.vocab_size, (plen,)).astype(np.int32)
-        if prefixes is not None:
+        if tenant_pools is not None:
+            t = int(np.searchsorted(tenant_cdf, rng.random()))
+            pool = tenant_pools[min(t, len(tenant_pools) - 1)]
+            pi = int(np.searchsorted(prefix_cdf, rng.random()))
+            prompt = np.concatenate([pool[min(pi, len(pool) - 1)],
+                                     prompt])
+        elif prefixes is not None:
             pi = int(np.searchsorted(prefix_cdf, rng.random()))
             prompt = np.concatenate([prefixes[min(pi, len(prefix_cdf)
                                                   - 1)], prompt])
@@ -250,4 +286,40 @@ def run_open_loop(engine, spec: LoadSpec, time_scale: float = 1.0,
     summary["requests_rejected"] = rejected
     summary["requests_throttled"] = throttled
     summary["watchdog_trips"] = watchdog_trips
+    return summary
+
+
+def run_fleet_open_loop(router, spec: LoadSpec,
+                        time_scale: float = 1.0,
+                        clock=time.perf_counter) -> dict:
+    """Drive a :class:`~.router.FleetRouter` through the same open-loop
+    arrival contract as :func:`run_open_loop`: the SAME seeded schedule
+    (so a fleet run and a single-engine run see identical traffic), the
+    router places each arrival, and every live replica is stepped
+    round-robin between arrivals. Router-level refusals (no ready
+    replica / all replicas shed) are counted, not crashed on. Returns
+    ``router.summary()`` augmented with the offered load."""
+    schedule = build_requests(spec)
+    t0 = clock()
+    i = 0
+    rejected = 0
+    while i < len(schedule) or any(
+            r.alive and r.engine.scheduler.has_work
+            for r in router.replicas.values()):
+        now = clock() - t0
+        while i < len(schedule) and \
+                schedule[i][0] * time_scale <= now:
+            try:
+                router.submit(schedule[i][1])
+            except ServerOverloaded:
+                rejected += 1
+            i += 1
+        if not router.step_all() and i < len(schedule):
+            wait = schedule[i][0] * time_scale - (clock() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+    summary = router.summary()
+    summary["offered_rate_rps"] = spec.rate_rps / max(time_scale, 1e-9)
+    summary["num_requests"] = spec.num_requests
+    summary["requests_rejected_router"] = rejected
     return summary

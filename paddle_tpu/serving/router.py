@@ -1,0 +1,694 @@
+"""Fleet front-end: prefix-affine routing over N serving replicas with
+chaos-proof migration (ISSUE 16).
+
+One :class:`~.engine.ServingEngine` is one host; millions of users are a
+fleet. The router is the piece that makes N engines act like one
+service:
+
+- **placement** is *prefix-affine*: requests consistent-hash on their
+  leading KV-page token key (block-size aligned, so two prompts that
+  share a first page hash identically), which keeps a shared-prefix
+  family pinned to one replica — the PR 14 radix cache then keeps
+  hitting at fleet scale instead of being diluted N ways;
+- **balancing** rides the PR 13 telemetry plane: a replica whose
+  ``/readyz`` says draining / shedding / watchdog-tripped takes no new
+  traffic, and when the affine replica is *saturated* (queue depth /
+  free-page floor from its ``/statusz`` data) the router falls back to
+  power-of-two-choices over the ready replicas — affinity is a
+  preference, never a hot-spot guarantee;
+- **migration** is the PR 8 drain path run THROUGH the router: a
+  graceful drain snapshots undone work (mid-chunk prefill progress,
+  trace_ids and all) and the router resubmits it on survivors via the
+  same affinity policy; a replica *death* has no cooperating engine, so
+  the router rebuilds each in-flight request's spec from its OWN
+  streaming records (original prompt + tokens streamed so far) and
+  pushes it through the same ``requests_from_snapshot`` restore —
+  either way the continuation is token-exact for greedy traffic and
+  no request id is dropped or duplicated.
+
+In-process replicas (CI, bench) call the engines' readiness/status
+providers directly — the very same callables the embedded admin server
+exposes over HTTP — so the routing logic is identical to an
+out-of-process deployment that polls ``/readyz`` + ``/statusz``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..monitor import get_registry
+from ..monitor.flight_recorder import safe_record_event
+from .resilience import (ServerOverloaded, load_drain_snapshot,
+                         requests_from_snapshot)
+from .sampling import SamplingParams
+from .scheduler import Request
+
+__all__ = ["FleetRouter", "ReplicaHandle", "RouterConfig"]
+
+#: engine outcomes the router treats as terminal for its own records
+#: ("drained" is NOT here: it means the work moved to a snapshot and a
+#: migration is re-homing it)
+_TERMINAL_OUTCOMES = ("completed", "failed", "cancelled", "expired",
+                      "shed")
+
+
+class ReplicaHandle:
+    """One serving replica as the router sees it: a name, a submit/step
+    surface behind a lock (an engine is single-threaded), and the SAME
+    readiness/status data its telemetry plane serves on ``/readyz`` and
+    ``/statusz``."""
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.alive = True
+        self.lock = threading.RLock()
+        #: wall time spent inside step() — the per-host busy-time model
+        #: the fleet bench aggregates over (in-process CPU replicas
+        #: share one GIL, so per-replica busy seconds, not router wall
+        #: time, is what maps to fleet wall time on real hosts)
+        self.busy_s = 0.0
+        self.last_error: Optional[BaseException] = None
+
+    def readiness(self) -> Optional[dict]:
+        """None = ready (the /readyz contract); a dead replica reports
+        itself the way a connection-refused poll would."""
+        if not self.alive:
+            return {"state": "dead"}
+        return self.engine._readiness()
+
+    def status(self) -> dict:
+        """The load-relevant slice of /statusz."""
+        sched = self.engine.scheduler
+        return {"queue_depth": sched.queue_depth,
+                "active_slots": sum(1 for s in sched.slots
+                                    if s is not None),
+                "free_pages": self.engine.cache.allocator.free_pages}
+
+    def submit(self, request: Request):
+        with self.lock:
+            return self.engine.submit(request)
+
+    def step(self) -> None:
+        with self.lock:
+            if not self.alive or not self.engine.scheduler.has_work:
+                return
+            t0 = time.perf_counter()
+            try:
+                self.engine.step()
+            finally:
+                self.busy_s += time.perf_counter() - t0
+
+
+@dataclass
+class RouterConfig:
+    """Routing policy knobs."""
+
+    #: leading KV pages of the prompt hashed as the affinity key —
+    #: prompts sharing their first ``affinity_blocks`` pages land on
+    #: the same replica (and therefore the same radix tree)
+    affinity_blocks: int = 1
+    #: ring points per replica (more = smoother key spread)
+    virtual_nodes: int = 64
+    #: affine replica overflows to power-of-two-choices past this
+    #: queue depth ...
+    saturation_queue_depth: int = 4
+    #: ... or when its free KV pages drop to this floor
+    saturation_free_pages: int = 0
+    #: root for migration drain snapshots (per-replica subdirs); None =
+    #: a tempdir is created on first graceful drain
+    drain_dir: Optional[str] = None
+    seed: int = 0
+
+
+@dataclass
+class _RouterRecord:
+    """The router's own durable view of one fleet request — enough to
+    rebuild its undone work WITHOUT the owning engine's cooperation
+    (the replica-death path)."""
+
+    request_id: int                     # fleet identity (first submit)
+    prompt: List[int]                   # ORIGINAL prompt tokens
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: Optional[int]
+    priority: int
+    client_on_token: Optional[Callable]
+    client_stop: Optional[Callable]
+    replica: str
+    tokens: List[int] = field(default_factory=list)   # streamed so far
+    trace_id: Optional[str] = None
+    hops: int = 0                       # migrations survived
+    done: bool = False
+    outcome: Optional[str] = None
+    state: object = None                # live RequestState, if any
+
+
+class FleetRouter:
+    """Prefix-affine, telemetry-driven front-end over named replicas.
+
+    ``replicas`` maps name → live :class:`~.engine.ServingEngine`.
+    Synchronous driving (:meth:`run`, deterministic — chaos drills and
+    the bench use it) and threaded driving (:meth:`start` /
+    :meth:`join` / :meth:`stop`, one serve thread per replica) share
+    the same routing and migration paths.
+    """
+
+    def __init__(self, replicas: Dict[str, object],
+                 config: Optional[RouterConfig] = None,
+                 clock=time.perf_counter):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.config = config or RouterConfig()
+        self.clock = clock
+        self.replicas: Dict[str, ReplicaHandle] = {
+            name: ReplicaHandle(name, eng)
+            for name, eng in replicas.items()}
+        block_sizes = {h.engine.config.block_size
+                       for h in self.replicas.values()}
+        if len(block_sizes) != 1:
+            raise ValueError(
+                f"replicas disagree on block_size ({sorted(block_sizes)}); "
+                "the affinity key is page-aligned and must mean the same "
+                "thing fleet-wide")
+        self.block_size = block_sizes.pop()
+        # consistent-hash ring: virtual_nodes points per replica, built
+        # once — membership changes (death/drain) are handled by
+        # SKIPPING not-ready owners while walking the ring, so the keys
+        # of healthy replicas never re-shuffle when one dies
+        ring = []
+        for name in self.replicas:
+            for v in range(self.config.virtual_nodes):
+                ring.append((self._hash(f"{name}#{v}".encode()), name))
+        ring.sort()
+        self._ring_keys = [h for h, _ in ring]
+        self._ring_names = [n for _, n in ring]
+        self._records: Dict[int, _RouterRecord] = {}
+        self._rng = np.random.default_rng(self.config.seed ^ 0xF1EE7)
+        self._route_lat: List[float] = []
+        self._stats = {"routed_affine": 0, "routed_balanced": 0,
+                       "rejected": 0, "migrated_drain": 0,
+                       "migrated_death": 0, "migration_failed": 0}
+        self._lock = threading.RLock()
+        self._threads: List[threading.Thread] = []
+        self._stop_evt = threading.Event()
+        self._tmp_drain_dir: Optional[str] = None
+
+    # -- placement ----------------------------------------------------------
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+    def _affinity_key(self, prompt) -> bytes:
+        """The shared-prefix token key: the first ``affinity_blocks``
+        KV pages' worth of prompt tokens. Page-aligned on purpose —
+        radix-cache reuse is page-granular, so two prompts that would
+        share cached pages hash identically."""
+        n = self.block_size * self.config.affinity_blocks
+        toks = np.asarray(prompt, np.int64).reshape(-1)[:n]
+        return toks.tobytes()
+
+    def _ready(self, rep: ReplicaHandle) -> bool:
+        return rep.alive and rep.readiness() is None
+
+    def _saturated(self, rep: ReplicaHandle) -> bool:
+        s = rep.status()
+        return (s["queue_depth"] >= self.config.saturation_queue_depth
+                or s["free_pages"] <= self.config.saturation_free_pages)
+
+    def _load(self, rep: ReplicaHandle):
+        s = rep.status()
+        return (s["queue_depth"] + s["active_slots"], -s["free_pages"])
+
+    def _affine_replica(self, prompt) -> Optional[ReplicaHandle]:
+        """Walk the ring clockwise from the key's position; first READY
+        owner wins (dead/draining owners are skipped, their keys spill
+        to the next replica on the ring — classic consistent hashing)."""
+        key = self._hash(self._affinity_key(prompt))
+        start = bisect.bisect_right(self._ring_keys, key)
+        seen = set()
+        for i in range(len(self._ring_names)):
+            name = self._ring_names[(start + i) % len(self._ring_names)]
+            if name in seen:
+                continue
+            seen.add(name)
+            rep = self.replicas[name]
+            if self._ready(rep):
+                return rep
+        return None
+
+    def _route(self, prompt) -> Optional[ReplicaHandle]:
+        affine = self._affine_replica(prompt)
+        if affine is not None and not self._saturated(affine):
+            self._stats["routed_affine"] += 1
+            get_registry().counter(
+                "serve_router_requests_total",
+                "requests placed by the fleet router, by route "
+                "kind").inc(route="affine")
+            return affine
+        ready = [r for r in self.replicas.values() if self._ready(r)]
+        if not ready:
+            return None
+        if len(ready) == 1:
+            pick = ready[0]
+        else:
+            # power-of-two-choices: two distinct random candidates,
+            # least-loaded wins — near-optimal balance at O(1) cost
+            i, j = self._rng.choice(len(ready), size=2, replace=False)
+            a, b = ready[int(i)], ready[int(j)]
+            pick = a if self._load(a) <= self._load(b) else b
+        self._stats["routed_balanced"] += 1
+        get_registry().counter(
+            "serve_router_requests_total",
+            "requests placed by the fleet router, by route "
+            "kind").inc(route="balanced")
+        return pick
+
+    # -- submission ---------------------------------------------------------
+    def _tee(self, rec: _RouterRecord) -> Callable:
+        """on_token wrapper that journals every streamed token into the
+        router's record (migration-by-death replays from it) before
+        forwarding to the client's callback."""
+        def on_token(req, token, text):
+            rec.tokens.append(int(token))
+            if rec.client_on_token is not None:
+                rec.client_on_token(req, token, text)
+        return on_token
+
+    def submit(self, request: Request):
+        """Route + submit one request. Raises
+        :class:`~.resilience.ServerOverloaded` when no ready replica
+        will take it (counted — availability accounting includes
+        refusals)."""
+        t0 = self.clock()
+        rep = self._route(request.prompt)
+        dt = self.clock() - t0
+        self._route_lat.append(dt)
+        get_registry().histogram(
+            "serve_router_route_seconds",
+            "fleet route-decision wall time").observe(dt)
+        if rep is None:
+            self._reject()
+            raise ServerOverloaded("no ready replica")
+        rec = _RouterRecord(
+            request_id=int(request.request_id),
+            prompt=[int(t) for t in
+                    np.asarray(request.prompt).reshape(-1)],
+            max_new_tokens=int(request.max_new_tokens),
+            sampling=request.sampling,
+            eos_token_id=request.eos_token_id,
+            priority=int(request.priority),
+            client_on_token=request.on_token,
+            client_stop=request.stop,
+            replica=rep.name)
+        request.on_token = self._tee(rec)
+        try:
+            st = rep.submit(request)
+        except ServerOverloaded:
+            # the chosen replica refused at its own door (bounded
+            # queue / overload detector): try every other ready
+            # replica least-loaded-first before giving up
+            for other in sorted(
+                    (r for r in self.replicas.values()
+                     if r is not rep and self._ready(r)),
+                    key=self._load):
+                try:
+                    st = other.submit(request)
+                    rep = other
+                    break
+                except ServerOverloaded:
+                    continue
+            else:
+                self._reject()
+                raise
+        rec.replica = rep.name
+        rec.state = st
+        tr = getattr(st, "trace", None)
+        rec.trace_id = (tr.trace_id if tr is not None
+                        else request.trace_id)
+        with self._lock:
+            self._records[rec.request_id] = rec
+        return rec
+
+    def _reject(self) -> None:
+        with self._lock:
+            self._stats["rejected"] += 1
+        get_registry().counter(
+            "serve_router_rejected_total",
+            "requests the router could not place on any ready "
+            "replica").inc()
+
+    # -- migration ----------------------------------------------------------
+    def _migration_dir(self, name: str) -> str:
+        import os
+        root = self.config.drain_dir
+        if root is None:
+            if self._tmp_drain_dir is None:
+                import tempfile
+                self._tmp_drain_dir = tempfile.mkdtemp(
+                    prefix="ptpu_router_drain_")
+            root = self._tmp_drain_dir
+        return os.path.join(root, name)
+
+    def _resubmit(self, rec: _RouterRecord, request: Request,
+                  reason: str) -> bool:
+        """Re-home one migrated request: affinity keyed on the ORIGINAL
+        prompt (the family's radix tree, not the grown continuation),
+        streaming continues into the same record, trace identity
+        survives."""
+        rec.state = None
+        target = self._affine_replica(rec.prompt)
+        if target is None or self._saturated(target):
+            picked = self._route(rec.prompt)
+            target = picked if picked is not None else target
+        if target is None:
+            rec.done = True
+            rec.outcome = "failed"
+            self._stats["migration_failed"] += 1
+            return False
+        request.on_token = self._tee(rec)
+        request.stop = rec.client_stop
+        try:
+            st = target.submit(request)
+        except ServerOverloaded:
+            rec.done = True
+            rec.outcome = "failed"
+            self._stats["migration_failed"] += 1
+            return False
+        rec.replica = target.name
+        rec.state = st
+        rec.hops += 1
+        self._stats[f"migrated_{reason}"] += 1
+        get_registry().counter(
+            "serve_router_migrations_total",
+            "in-flight requests re-homed onto a surviving replica, by "
+            "cause").inc(reason=reason)
+        safe_record_event("replica_migration", reason=reason,
+                          request_id=rec.request_id,
+                          to_replica=target.name, hops=rec.hops,
+                          tokens_streamed=len(rec.tokens))
+        return True
+
+    def drain_replica(self, name: str,
+                      budget_s: Optional[float] = None) -> dict:
+        """Graceful hand-off: the engine's PR 8 drain finishes what it
+        can inside the budget and snapshots the rest (mid-chunk prefill
+        progress, trace_ids and all); the router restores the snapshot
+        through ``requests_from_snapshot`` and re-homes every spec on a
+        survivor. The drained replica stays alive-but-draining (its
+        /readyz already says so), taking no new traffic."""
+        rep = self.replicas[name]
+        snap_dir = self._migration_dir(name)
+        with rep.lock:
+            report = rep.engine.drain(snapshot_dir=snap_dir,
+                                      budget_s=budget_s)
+        moved = 0
+        if report.snapshotted:
+            _, specs = load_drain_snapshot(snap_dir)
+            with self._lock:
+                by_cur_id = {}
+                for rec in self._records.values():
+                    st = rec.state
+                    if rec.replica == name and st is not None:
+                        by_cur_id[int(st.request.request_id)] = rec
+                for spec in specs:
+                    rec = by_cur_id.get(int(spec["request_id"]))
+                    if rec is None or rec.done:
+                        continue
+                    reqs = requests_from_snapshot([spec])
+                    if not reqs:
+                        continue
+                    if self._resubmit(rec, reqs[0], reason="drain"):
+                        moved += 1
+        self._sweep()
+        return {"replica": name, "completed": report.completed,
+                "snapshotted": report.snapshotted, "migrated": moved}
+
+    def kill_replica(self, name: str) -> int:
+        """Simulated replica death (the chaos drill): NO cooperation
+        from the dying engine — the router rebuilds each in-flight
+        request's spec from its own streaming journal (original prompt
+        + tokens already streamed to the client) and restores it
+        through the same ``requests_from_snapshot`` path the drain
+        uses. Committed tokens were streamed, so the continuation is
+        token-exact; uncommitted work (mid-chunk prefill, staged
+        drafts) recomputes on the survivor. Returns how many requests
+        migrated."""
+        rep = self.replicas[name]
+        rep.alive = False
+        with rep.lock:                   # wait out any in-flight step
+            rep.engine.shutdown()        # post-mortem cleanup only
+        moved = 0
+        with self._lock:
+            for rec in list(self._records.values()):
+                if rec.replica != name or rec.done:
+                    continue
+                if (rec.eos_token_id is not None
+                        and rec.eos_token_id in rec.tokens):
+                    # the stream already ended (eos was streamed):
+                    # nothing undone, just close the record
+                    rec.done = True
+                    rec.outcome = "completed"
+                    rec.state = None
+                    continue
+                spec = {
+                    "request_id": rec.request_id,
+                    "prompt": list(rec.prompt),
+                    "generated": list(rec.tokens),
+                    "max_new_tokens": rec.max_new_tokens,
+                    "sampling": {
+                        "temperature": rec.sampling.temperature,
+                        "top_k": rec.sampling.top_k,
+                        "top_p": rec.sampling.top_p},
+                    "eos_token_id": rec.eos_token_id,
+                    "priority": rec.priority,
+                }
+                if rec.trace_id is not None:
+                    spec["trace_id"] = rec.trace_id
+                reqs = requests_from_snapshot([spec])
+                if not reqs:
+                    # budget exhausted before the death: completed
+                    rec.done = True
+                    rec.outcome = "completed"
+                    rec.state = None
+                    continue
+                if self._resubmit(rec, reqs[0], reason="death"):
+                    moved += 1
+        return moved
+
+    # -- driving ------------------------------------------------------------
+    def _sweep(self) -> None:
+        """Fold engine-side completions into the router's records and
+        refresh the fleet gauges."""
+        with self._lock:
+            for rec in self._records.values():
+                st = rec.state
+                if rec.done or st is None:
+                    continue
+                if st.outcome in _TERMINAL_OUTCOMES:
+                    rec.done = True
+                    rec.outcome = st.outcome
+                    rec.state = None
+
+    def step_all(self) -> bool:
+        """One synchronous round-robin pass over the live replicas.
+        Returns whether any replica had work (False = fleet idle)."""
+        worked = False
+        for rep in self.replicas.values():
+            if rep.alive and rep.engine.scheduler.has_work:
+                rep.step()
+                worked = True
+        self._sweep()
+        return worked
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive the fleet in the calling thread until idle
+        (deterministic — drills and the bench use this mode)."""
+        steps = 0
+        while self.step_all():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 16,
+                 sampling: Optional[SamplingParams] = None,
+                 eos_token_id: Optional[int] = None) -> List[np.ndarray]:
+        """Batch convenience mirroring ``ServingEngine.generate``, but
+        fleet-routed: submit all, run to idle, return full sequences
+        (prompt + streamed tokens) in submission order — migration-
+        transparent, because the router's journal IS the stream."""
+        recs = [self.submit(Request(
+            p, max_new_tokens=max_new_tokens,
+            sampling=sampling or SamplingParams(),
+            eos_token_id=eos_token_id)) for p in prompts]
+        self.run()
+        return [np.asarray(rec.prompt + rec.tokens, np.int32)
+                for rec in recs]
+
+    def start(self) -> None:
+        """Threaded driving: one serve loop per replica (each engine
+        stays single-threaded behind its handle lock)."""
+        if self._threads:
+            return
+        self._stop_evt.clear()
+        for rep in self.replicas.values():
+            t = threading.Thread(target=self._serve_loop, args=(rep,),
+                                 name=f"ptpu-replica-{rep.name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_loop(self, rep: ReplicaHandle) -> None:
+        while not self._stop_evt.is_set():
+            if rep.alive and rep.engine.scheduler.has_work:
+                try:
+                    rep.step()
+                except Exception as e:      # noqa: BLE001
+                    # a replica's failure must never take the router
+                    # thread pool down; the engine's own fault
+                    # isolation / readiness reporting covers the rest
+                    rep.last_error = e
+                self._sweep()
+            else:
+                self._stop_evt.wait(0.002)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the fleet is idle (threaded mode). Returns False
+        on timeout."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        while any(r.alive and r.engine.scheduler.has_work
+                  for r in self.replicas.values()):
+            if deadline is not None and time.perf_counter() > deadline:
+                return False
+            time.sleep(0.002)
+        self._sweep()
+        return True
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+
+    # -- observability ------------------------------------------------------
+    def _publish_fleet_gauges(self) -> None:
+        reg = get_registry()
+        ready = alive = 0
+        for name, rep in self.replicas.items():
+            if rep.alive:
+                alive += 1
+                if self._ready(rep):
+                    ready += 1
+                s = rep.status()
+                ms = rep.engine.metrics_summary()
+                reg.gauge(
+                    "serve_router_replica_queue_depth",
+                    "per-replica waiting-queue depth as the router "
+                    "sees it").set(s["queue_depth"], replica=name)
+                reg.gauge(
+                    "serve_router_replica_prefix_hit_pct",
+                    "per-replica radix prefix-cache hit percentage"
+                ).set(ms.get("prefix_hit_pct") or 0.0, replica=name)
+                reg.gauge(
+                    "serve_router_replica_shed_requests",
+                    "per-replica cumulative shed count").set(
+                    rep.engine.scheduler.stats.get("shed", 0),
+                    replica=name)
+        reg.gauge("serve_router_replicas",
+                  "fleet size by state").set(alive, state="alive")
+        reg.gauge("serve_router_replicas",
+                  "fleet size by state").set(ready, state="ready")
+
+    def summary(self) -> dict:
+        """Fleet-level rollup: aggregate throughput (per-host busy-time
+        model), fleet prefix hit%, availability accounting (offered =
+        completed + failed-ish + rejected; nothing dropped, nothing
+        double-counted), migration and routing counters, and per-replica
+        summaries."""
+        self._sweep()
+        self._publish_fleet_gauges()
+        per = {}
+        tot_tokens = 0
+        hit_tokens = 0
+        prefill_tokens = 0
+        busy = []
+        for name, rep in self.replicas.items():
+            ms = rep.engine.metrics_summary()
+            per[name] = {
+                "alive": rep.alive,
+                "busy_s": rep.busy_s,
+                "tokens_generated": ms.get("tokens_generated", 0),
+                "tokens_per_sec": ms.get("tokens_per_sec", 0.0),
+                "prefix_hit_pct": ms.get("prefix_hit_pct", 0.0),
+                "requests_completed": ms.get("requests_completed", 0),
+                "shed": rep.engine.scheduler.stats.get("shed", 0),
+            }
+            tot_tokens += ms.get("tokens_generated", 0)
+            hit_tokens += ms.get("prefix_hit_tokens", 0)
+            prefill_tokens += ms.get("prefill_tokens", 0)
+            if rep.busy_s > 0:
+                busy.append(rep.busy_s)
+        with self._lock:
+            recs = list(self._records.values())
+            stats = dict(self._stats)
+            lat = sorted(self._route_lat)
+        completed = sum(1 for r in recs
+                        if r.done and r.outcome == "completed")
+        failed = sum(1 for r in recs
+                     if r.done and r.outcome != "completed")
+        in_flight = sum(1 for r in recs if not r.done)
+        offered = len(recs) + stats["rejected"]
+        ids = [r.request_id for r in recs]
+        q = (lambda p: lat[min(len(lat) - 1,
+                               int(p * (len(lat) - 1)))] if lat else 0.0)
+        # per-host wall-time model: replicas on real hosts run
+        # concurrently, so fleet wall time is the BUSIEST replica's
+        # busy seconds (in-process CPU replicas serialize on the GIL;
+        # summing their wall would charge the fleet for it)
+        wall = max(busy) if busy else 0.0
+        return {
+            "replicas": per,
+            "num_replicas": len(self.replicas),
+            "alive_replicas": sum(1 for r in self.replicas.values()
+                                  if r.alive),
+            "tokens_generated": tot_tokens,
+            "aggregate_tokens_per_sec": (tot_tokens / wall
+                                         if wall > 0 else 0.0),
+            "fleet_prefix_hit_pct": (
+                100.0 * hit_tokens
+                / max(1, hit_tokens + prefill_tokens)),
+            "requests_offered": offered,
+            "requests_completed": completed,
+            "requests_failed": failed,
+            "requests_rejected": stats["rejected"],
+            "requests_in_flight": in_flight,
+            "availability_pct": (100.0 * completed / offered
+                                 if offered else 100.0),
+            "duplicate_request_ids": len(ids) - len(set(ids)),
+            "routed_affine": stats["routed_affine"],
+            "routed_balanced": stats["routed_balanced"],
+            "migrated_drain": stats["migrated_drain"],
+            "migrated_death": stats["migrated_death"],
+            "migration_failed": stats["migration_failed"],
+            "route_overhead_p50_s": q(0.50),
+            "route_overhead_p99_s": q(0.99),
+        }
+
+    def shutdown(self) -> None:
+        """Stop threads and shut every live replica down."""
+        self.stop()
+        for rep in self.replicas.values():
+            if rep.alive:
+                rep.alive = False
+                with rep.lock:
+                    rep.engine.shutdown()

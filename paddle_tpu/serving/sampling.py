@@ -18,7 +18,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SamplingParams", "sample_tokens"]
+__all__ = ["SamplingParams", "filtered_logits", "sample_tokens"]
 
 _NEG = -1e30
 
@@ -38,18 +38,16 @@ class SamplingParams:
             raise ValueError(f"top_k must be >= 0, got {self.top_k}")
 
 
-def sample_tokens(logits, key, temperature, top_k, top_p):
-    """Next token per row from ``[B, V]`` logits.
-
-    ``temperature``/``top_p`` are ``[B]`` f32, ``top_k`` ``[B]`` int32.
-    Rows with ``temperature <= 0`` take the argmax (their filtered-
-    sampling lane still computes but is discarded by the final select —
-    the price of one branch-free program). Returns ``[B]`` int32.
-    """
+def filtered_logits(logits, temperature, top_k, top_p):
+    """The temperature-scaled, top-k/top-p-filtered ``[B, V]`` logits
+    that :func:`sample_tokens` draws from, with filtered-away entries at
+    ``-1e30``. Exposed separately because speculative *stochastic*
+    verification (ISSUE 16) needs the full per-row distribution — the
+    accept probability of a drafted token is its softmax mass here, and
+    the residual redraw samples from the same rows with the draft masked
+    out — not just one sample."""
     logits = logits.astype(jnp.float32)
     V = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     lg = logits / jnp.maximum(temperature, 1e-6)[:, None]
     # top-k: keep values >= the k-th largest; k<=0 means keep all
     srt = jnp.sort(lg, axis=-1)[:, ::-1]                      # descending
@@ -64,7 +62,19 @@ def sample_tokens(logits, key, temperature, top_k, top_p):
     cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
     cutoff = jnp.take_along_axis(
         srt2, jnp.clip(cutoff_idx, 0, V - 1)[:, None], axis=-1)
-    lg = jnp.where(lg < cutoff, _NEG, lg)
+    return jnp.where(lg < cutoff, _NEG, lg)
 
+
+def sample_tokens(logits, key, temperature, top_k, top_p):
+    """Next token per row from ``[B, V]`` logits.
+
+    ``temperature``/``top_p`` are ``[B]`` f32, ``top_k`` ``[B]`` int32.
+    Rows with ``temperature <= 0`` take the argmax (their filtered-
+    sampling lane still computes but is discarded by the final select —
+    the price of one branch-free program). Returns ``[B]`` int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)

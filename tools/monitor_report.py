@@ -28,6 +28,11 @@ stream — prints:
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
   occupancy, queue-depth/slot/page gauges and serving program HBM
   budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
+- with ``--fleet``: the fleet router's per-replica table (queue depth,
+  prefix hit%, shed counts) and routing/migration counters + route
+  latency (``serve_router_*`` series from paddle_tpu.serving.router;
+  docs/SERVING.md fleet topology; rendered before --serve so router
+  series appear here, once);
 - with ``--recsys``: the embedding-tier view — per-table occupancy and
   hit rates across the HBM/host/SSD tiers, promotion/eviction
   counters, per-table HBM attribution and sharded-lookup fallbacks
@@ -67,7 +72,7 @@ tree with per-span duration, EXCLUSIVE time and the critical path
 (docs/OBSERVABILITY.md "Structured tracing").
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--slo] [--comms] [--moe] [--recsys] [--fallbacks]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--fleet] [--slo] [--comms] [--moe] [--recsys] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
@@ -561,6 +566,51 @@ def _overload_timeline(rows: List[dict], used) -> List[str]:
     return _table("Overload state timeline", ["t", "state"], out)
 
 
+def _fleet_section(latest, used) -> List[str]:
+    """--fleet: the router's per-replica table (queue depth, prefix
+    hit%, shed count — the ``serve_router_replica_*`` gauges) plus the
+    fleet routing/migration counters and route-decision latency
+    (docs/SERVING.md fleet topology). Runs BEFORE --serve's generic
+    serve_* catch-all so router series render here, once."""
+    per: Dict[str, dict] = {}
+    totals = []
+    for key in sorted(latest):
+        name, labels = key
+        if not name.startswith("serve_router_"):
+            continue
+        row = latest[key]
+        used.add(key)
+        lab = dict(labels)
+        rep = lab.get("replica")
+        if rep is not None:
+            per.setdefault(rep, {})[name] = row.get("value", 0)
+        elif name == "serve_router_route_seconds":
+            n = int(row.get("count") or 0)
+            mean = (row["sum"] / n * 1e3) if n else 0.0
+            p99 = _hist_pct(row, 0.99)
+            totals.append([name, _fmt_labels(labels),
+                           f"{n} routed, mean {mean:,.3f} ms, ~p99 <= "
+                           f"{(p99 or 0) * 1e3:,.3f} ms"])
+        else:
+            totals.append([name, _fmt_labels(labels),
+                           f"{row.get('value', 0):g}"])
+    rep_rows = [
+        [rep,
+         f"{d.get('serve_router_replica_queue_depth', 0):g}",
+         f"{d.get('serve_router_replica_prefix_hit_pct', 0):.1f}",
+         f"{d.get('serve_router_replica_shed_requests', 0):g}"]
+        for rep, d in sorted(per.items())]
+    out = _table("Fleet replicas (router view)",
+                 ["replica", "queue depth", "prefix hit%", "shed"],
+                 rep_rows)
+    out += _table("Fleet router counters", ["metric", "labels", "value"],
+                  totals)
+    if not out:
+        out = ["== Fleet ==", "(no serve_router_* metrics in this dump "
+               "— run a FleetRouter first)", ""]
+    return out
+
+
 def _serve_section(latest, used, raw_rows: Optional[List[dict]] = None) \
         -> List[str]:
     """--serve: per-request latency histograms, request outcomes, the
@@ -631,7 +681,8 @@ _RECOVERY_EVENTS_FALLBACK = (
     "checkpoint_commit", "checkpoint_fallback", "collective_timeout",
     "nonfinite_skip", "preempted", "trip", "chaos", "request_failed",
     "request_expired", "request_cancelled", "request_drained",
-    "request_shed", "decode_watchdog", "overload", "drained")
+    "request_shed", "decode_watchdog", "overload", "drained",
+    "replica_migration")
 
 
 def _recovery_events() -> tuple:
@@ -815,14 +866,19 @@ def render_traces(traces: List[dict], last: int = 10) -> str:
 def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
            moe: bool = False, fallbacks: bool = False,
-           recsys: bool = False, slo: bool = False) -> str:
+           recsys: bool = False, slo: bool = False,
+           fleet: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
-    # -- serving (--serve) first: its histograms would otherwise be
+    # -- fleet router (--fleet) first: it must claim the serve_router_*
+    # series before --serve's generic serve_* catch-all slurps them ------
+    serve_out: List[str] = (_fleet_section(latest, used)
+                            if fleet else [])
+    # -- serving (--serve) next: its histograms would otherwise be
     # swallowed by the generic slowest-events table ----------------------
-    serve_out: List[str] = (_serve_section(latest, used, raw_rows=rows)
-                            if serve else [])
+    serve_out += (_serve_section(latest, used, raw_rows=rows)
+                  if serve else [])
     # -- SLO burn (--slo) renders next to --serve ------------------------
     serve_out += _slo_section(latest, used) if slo else []
     # -- comm overlap (--comms) also claims its gauges early -------------
@@ -965,6 +1021,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve = "--serve" in argv
     if serve:
         argv.remove("--serve")
+    fleet = "--fleet" in argv
+    if fleet:
+        argv.remove("--fleet")
     comms = "--comms" in argv
     if comms:
         argv.remove("--comms")
@@ -1014,7 +1073,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
-                 moe=moe, fallbacks=fallbacks, recsys=recsys, slo=slo),
+                 moe=moe, fallbacks=fallbacks, recsys=recsys, slo=slo,
+                 fleet=fleet),
           end="")
     return 0
 
