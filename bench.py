@@ -630,7 +630,9 @@ def bench_serve(quick: bool = False) -> list:
                                                  quick=quick)
     fleet_lines = serve_fleet_metrics(model, name, serve_cfg,
                                       quick=quick)
-    return throughput_lines + fleet_lines + [
+    mt_lines = serve_multitenant_metrics(model, name, serve_cfg,
+                                         quick=quick)
+    return throughput_lines + fleet_lines + mt_lines + [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
                     vs_baseline=1.0,
@@ -907,6 +909,140 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
     ]
 
 
+def serve_multitenant_metrics(model, name, serve_cfg, quick: bool) -> list:
+    """ISSUE 17 legs: the multi-tenant LoRA + int8-quantized-KV serving
+    shape. One flags-off oracle engine and one multi-tenant engine
+    (``FLAGS_serve_kv_quant=int8``, a LoRAManager pool with one adapter
+    per tenant/id in the traffic, a per-tenant admission quota) serve
+    the SAME seeded tenanted workload. Records
+    ``serve_kv_bytes_per_token`` (bytes/token, lower-is-better; refused
+    unless int8 lands at or below 0.55x the bf16 full-precision
+    footprint) and ``serve_lora_adapters_per_chip`` (adapters,
+    higher-is-better; refused unless the multi-tenant decode p99 held
+    the fixed budget of 1.5x the oracle's p99) — and REFUSES to record
+    anything unless zero-adapter greedy decode under quant is
+    token-identical to the flags-off oracle (same contract as the
+    feature/fleet legs)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.serving import (LoadSpec, SamplingParams,
+                                    ServingEngine, run_open_loop)
+
+    cfg = model.cfg
+    n_tenants, per_tenant = (3, 2) if quick else (4, 2)
+    rank = 4 if quick else 8
+    if quick:
+        spec = LoadSpec(num_requests=12, rate_rps=40.0,
+                        prompt_len_range=(4, 12), max_new_range=(4, 10),
+                        vocab_size=cfg.vocab_size, seed=23,
+                        sampling=SamplingParams(), shared_prefix_len=8,
+                        prefix_pool_size=2, tenants=n_tenants,
+                        adapter_pool=per_tenant)
+    else:
+        spec = LoadSpec(num_requests=24, rate_rps=6.0,
+                        prompt_len_range=(16, 64),
+                        max_new_range=(8, 24),
+                        vocab_size=cfg.vocab_size, seed=23,
+                        sampling=SamplingParams(), shared_prefix_len=32,
+                        prefix_pool_size=2, tenants=n_tenants,
+                        adapter_pool=per_tenant)
+    rng = np.random.default_rng(29)
+    parity_prompts = [rng.integers(0, cfg.vocab_size, (n,)).tolist()
+                      for n in (9, 6, 12)]
+
+    def phase(multitenant: bool):
+        if multitenant:
+            eng_cfg = dataclasses.replace(
+                serve_cfg, lora_adapters=n_tenants * per_tenant,
+                lora_rank=rank,
+                tenant_quota=max(2, serve_cfg.max_batch_slots // 2))
+            with flag_scope("serve_kv_quant", "int8"):
+                eng = ServingEngine(model, eng_cfg)
+            # one LoRA adapter per tenant/id the traffic can name,
+            # hot-swapped in through the manager (tiny magnitudes: the
+            # leg measures serving capacity, not adapter quality)
+            wrng = np.random.default_rng(31)
+            L, E, r = cfg.num_layers, cfg.hidden_size, rank
+            O = 3 * cfg.hidden_size
+            for t in range(n_tenants):
+                for k in range(per_tenant):
+                    eng.lora.load_adapter(
+                        f"tenant{t}/adapter{k}",
+                        weights=(wrng.standard_normal((L, r, E))
+                                 .astype(np.float32) * 1e-3,
+                                 wrng.standard_normal((L, r, O))
+                                 .astype(np.float32) * 1e-3))
+        else:
+            eng = ServingEngine(model, dataclasses.replace(serve_cfg))
+        eng.warmup()
+        # zero-adapter greedy parity probe: base requests on the
+        # multi-tenant engine ride the zero adapter (delta exactly 0.0),
+        # so only the int8 KV path separates the two engines here
+        outs = [o[-8:].tolist() for o in eng.generate(
+            parity_prompts, max_new_tokens=8)]
+        # the oracle has no LoRA manager, so its copy of the workload
+        # drops the adapter ids; adapter_pool draws from a side RNG, so
+        # prompts, lengths and arrival times stay byte-identical
+        summary = run_open_loop(
+            eng, spec if multitenant
+            else dataclasses.replace(spec, adapter_pool=0))
+        summary["kv_bytes_per_token"] = eng.cache.kv_bytes_per_token()
+        eng.shutdown()
+        return summary, outs
+
+    s_off, outs_off = phase(False)
+    s_mt, outs_mt = phase(True)
+    if outs_mt != outs_off:
+        log("serve[multitenant]: PARITY FAILURE — zero-adapter greedy "
+            "outputs under FLAGS_serve_kv_quant=int8 diverge from the "
+            "flags-off oracle; refusing to record the multi-tenant legs")
+        log(f"  off: {outs_off}\n  on:  {outs_mt}")
+        return []
+    lines = []
+    n_chips = max(1, jax.device_count())
+    # footprint bound vs FULL-PRECISION bf16 pages (the documented
+    # acceptance bound, independent of this engine's configured cache
+    # dtype): int8 pages + f32 per-(position, head) scales
+    bf16_bytes = 2 * cfg.num_layers * cfg.num_heads * \
+        (cfg.hidden_size // cfg.num_heads) * 2
+    bq, boff = s_mt["kv_bytes_per_token"], s_off["kv_bytes_per_token"]
+    log(f"serve[multitenant/{name}]: kv bytes/token {boff} -> {bq} "
+        f"({bq / max(boff, 1):.2f}x vs flags-off, "
+        f"{bq / max(bf16_bytes, 1):.2f}x vs bf16 full precision)")
+    if bq <= 0.55 * bf16_bytes:
+        lines.append(metric_line(
+            "serve_kv_bytes_per_token", bq, "bytes/token",
+            vs_baseline=1.0, flags_off_bytes=boff,
+            vs_bf16=round(bq / max(bf16_bytes, 1), 3)))
+    else:
+        log("serve[multitenant]: int8 KV footprint exceeds 0.55x bf16 "
+            "— refusing to record serve_kv_bytes_per_token")
+    # adapters-per-chip at a FIXED p99 budget: the count only records
+    # while the multi-tenant decode p99 holds 1.5x the oracle's
+    p99_off = s_off["decode_step_p99_s"] or 0.0
+    p99_mt = s_mt["decode_step_p99_s"] or 0.0
+    budget = 1.5 * p99_off
+    n_adapters = n_tenants * per_tenant
+    log(f"serve[multitenant/{name}]: {n_adapters} adapters over "
+        f"{n_tenants} tenants, decode p99 {p99_mt * 1e3:.1f} ms vs "
+        f"budget {budget * 1e3:.1f} ms (1.5x oracle), "
+        f"{s_mt['requests_completed']}/{spec.num_requests} completed, "
+        f"quota deferrals {s_mt.get('quota_deferred', 0)}")
+    if p99_off > 0 and p99_mt <= budget:
+        lines.append(metric_line(
+            "serve_lora_adapters_per_chip", n_adapters / n_chips,
+            "adapters", vs_baseline=1.0,
+            p99_ms=round(p99_mt * 1e3, 2),
+            budget_ms=round(budget * 1e3, 2)))
+    else:
+        log("serve[multitenant]: decode p99 blew the fixed budget — "
+            "refusing to record serve_lora_adapters_per_chip")
+    return lines
+
+
 def serve_trace_overhead(engine, spec) -> float:
     """Measured tokens/s cost of structured tracing at sample rate 1.0
     (every request traced — the worst case; production head-samples at
@@ -1091,6 +1227,33 @@ def bench_kernels(quick: bool = False) -> list:
         metric_line("kernel_paged_decode_ms", ms, "ms", vs_baseline=1.0,
                     kernel_live=live),
         metric_line("kernel_paged_decode_gbps", gbps(by, ms), "GB/s",
+                    vs_baseline=1.0, kernel_live=live),
+    ]
+
+    # -- batched LoRA gather-matmul (bgmv): per-slot adapter deltas --------
+    B, S, r, E = (4, 1, 4, 64) if quick else (8, 1, 16, 1024)
+    O, A = 3 * E, 9                              # row 0 = zero adapter
+    x = jnp.asarray(rng.randn(B, S, E).astype(np.float32))
+    ap = jnp.asarray(rng.randn(A, r, E).astype(np.float32) * 0.05)
+    bp = jnp.asarray(rng.randn(A, r, O).astype(np.float32) * 0.05)
+    ids = jnp.asarray(rng.randint(0, A, (B,)).astype(np.int32))
+    live = float(pallas_ops.kernel_enabled("bgmv", note=False))
+    if live:
+        from paddle_tpu.ops.pallas.bgmv import bgmv as _bgmv
+    else:
+        from paddle_tpu.ops.pallas.bgmv import bgmv_xla as _bgmv
+    fnb = jax.jit(_bgmv)
+    fnb(x, ap, bp, ids).block_until_ready()
+    ms = steady_ms(lambda: fnb(x, ap, bp, ids).ravel()[0],
+                   iters=5 if quick else 20)
+    # bytes the op must move: x + the B gathered adapter rows + out
+    by = (B * S * E + B * r * (E + O) + B * S * O) * 4
+    log(f"kernels[bgmv]: B={B} r={r} E={E} O={O} {ms:.3f} ms, "
+        f"{gbps(by, ms):.1f} GB/s (live={live:.0f})")
+    lines += [
+        metric_line("kernel_bgmv_ms", ms, "ms", vs_baseline=1.0,
+                    kernel_live=live),
+        metric_line("kernel_bgmv_gbps", gbps(by, ms), "GB/s",
                     vs_baseline=1.0, kernel_live=live),
     ]
 

@@ -283,6 +283,26 @@ define_flag("pallas_int8", True,
             "activations dynamically per tensor). Off = the pre-kernel "
             "XLA paths (weight-only: dequantize-to-float matmul; static "
             "act_scale: XLA int8 dot).")
+define_flag("pallas_bgmv", True,
+            "Serve batched-LoRA shrink/expand projections (serving, "
+            "multi-tenant decode) with the Pallas bgmv kernel "
+            "(ops.pallas.bgmv): each slot's adapter id scalar-prefetch-"
+            "indexes the stacked [n_adapters, r, d] A/B pools so the "
+            "per-slot adapter weights are DMA'd straight from the pool "
+            "— the gathered [B, r, d] copies never materialize in HBM. "
+            "Off = the XLA gather + einsum composition (bit-identical "
+            "to the pre-kernel math).")
+define_flag("serve_kv_quant", "",
+            "Quantized paged KV cache (paddle_tpu.serving.kv_cache): "
+            "'int8' stores the K/V page pools as int8 with per-page, "
+            "per-token-row, per-head absmax scales in a parallel f32 "
+            "scale pool — roughly halving bytes per cached token vs "
+            "bf16 (so ~2x slots per chip) at a documented greedy-decode "
+            "parity bound. Quantization happens at write_pages; both "
+            "the Pallas paged flash-decode kernel and the XLA gather "
+            "fallback dequantize at read. Empty (default) = the "
+            "bit-compatible full-precision pools (the flags-off "
+            "oracle). Read once at engine/cache construction.")
 define_flag("amp_int8_matmul", False,
             "EXPERIMENTAL: under an active amp.auto_cast region, run "
             "eligible nn.functional.linear matmuls through the Pallas "

@@ -186,8 +186,18 @@ def shard_serving_cache(cache, mesh: Mesh):
     the KV footprint, which is what lets models beyond single-chip HBM
     serve at all."""
     sh = NamedSharding(mesh, degrade_spec(SERVE_KV_SPEC, mesh))
-    cache.k = jax.device_put(cache.k, sh)
-    cache.v = jax.device_put(cache.v, sh)
+    # quantized pools (FLAGS_serve_kv_quant) are (pages, scales) tuples:
+    # the [L, P, bs, H] scale pool shards its heads dim the same way
+    sc = NamedSharding(mesh, degrade_spec(P(None, None, None, "mp"), mesh))
+
+    def _put(pool):
+        if isinstance(pool, tuple):
+            pages, scales = pool
+            return (jax.device_put(pages, sh), jax.device_put(scales, sc))
+        return jax.device_put(pool, sh)
+
+    cache.k = _put(cache.k)
+    cache.v = _put(cache.v)
     return cache
 
 

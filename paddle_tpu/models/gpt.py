@@ -179,10 +179,6 @@ class GPTAttention(Layer):
             return y
 
         qkv = apply(qkv_fn, x, self.qkv_weight, self.qkv_bias, name="fused_qkv")
-        qkv = _constrain(qkv, BATCH, None, None, MP, None)
-        from ..tensor.manipulation import split as tsplit, squeeze
-        q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
-
         # the serving layer is only imported once a paged cache actually
         # arrives — training forwards (cache=None) never touch it
         is_paged = False
@@ -190,6 +186,15 @@ class GPTAttention(Layer):
                 not isinstance(cache, GPTAttention.StaticCache):
             from ..serving.kv_cache import PagedLayerCache
             is_paged = isinstance(cache, PagedLayerCache)
+        if is_paged and cache.lora_a is not None:
+            # multi-tenant LoRA (serving.lora): per-slot adapter deltas
+            # on the fused QKV projection, batched over adapters via
+            # bgmv. Absent pools (the default) add nothing to the graph.
+            qkv = qkv + self._lora_delta(x, cache)
+        qkv = _constrain(qkv, BATCH, None, None, MP, None)
+        from ..tensor.manipulation import split as tsplit, squeeze
+        q, k, v = (squeeze(t, 2) for t in tsplit(qkv, 3, axis=2))
+
         if is_paged:
             out, cache = self._paged_attention(x, q, k, v, cache, pos)
         elif isinstance(cache, GPTAttention.StaticCache):
@@ -235,6 +240,29 @@ class GPTAttention(Layer):
         y = apply(out_fn, out, self.out_weight, self.out_bias, name="attn_out")
         return (y, cache) if cache is not None else y
 
+    def _lora_delta(self, x, cache):
+        """Batched-LoRA delta for the fused QKV projection
+        (serving.lora, ISSUE 17): each slot's adapter row of the stacked
+        ``[A, r, E]`` / ``[A, r, 3*H*D]`` pools is gathered + applied by
+        the bgmv kernel (``FLAGS_pallas_bgmv``; off = the bit-compatible
+        XLA gather+einsum oracle). Returns ``[B, S, 3, H, D]`` in x's
+        dtype — row-0 (zero-adapter) slots contribute exactly 0.0."""
+        from ..ops import pallas as pallas_ops
+        # dispatch resolved OUTSIDE the traced fn, like paged_decode
+        use_kernel = pallas_ops.kernel_enabled("bgmv")
+        H, D = self.num_heads, self.head_dim
+
+        def delta_fn(h, a, b, ids):
+            if use_kernel:
+                from ..ops.pallas.bgmv import bgmv as _bgmv
+            else:
+                from ..ops.pallas.bgmv import bgmv_xla as _bgmv
+            d = _bgmv(h, a, b, ids.astype(jnp.int32))     # [B, S, 3*H*D]
+            return d.reshape(d.shape[0], d.shape[1], 3, H, D)
+
+        return apply(delta_fn, x, cache.lora_a, cache.lora_b,
+                     cache.lora_ids, name="lora_qkv_delta")
+
     def _paged_attention(self, x, q, k, v, cache, pos):
         """Block-table K/V path (paddle_tpu.serving, ISSUE 6).
 
@@ -247,20 +275,41 @@ class GPTAttention(Layer):
         exact math of the full-context forward; decode (S == 1) gathers
         the slot's pages and masks columns past ``pos``, i.e.
         PagedAttention as one XLA gather + masked SDPA.
+
+        A quantized cache (``cache.k_scale is not None``,
+        ``FLAGS_serve_kv_quant=int8``) quantizes at write time and
+        dequantizes at every page read — both the Pallas decode kernel
+        and the XLA gather fallback — so the two dispatch paths stay
+        token-exact against each other.
         """
         from ..serving.kv_cache import (PagedLayerCache, gather_pages,
-                                        write_pages)
+                                        gather_pages_quant, write_pages,
+                                        write_pages_quant)
 
-        def upd(pages, new, table, p):
-            return write_pages(pages, new, table, p)
+        quant = cache.k_scale is not None
+        if quant:
+            def updq(pages, scales, new, table, p):
+                return write_pages_quant(pages, scales, new, table, p)
 
-        kp = apply(upd, cache.k_pages, k, cache.block_table, pos,
-                   name="paged_kv_write")
-        vp = apply(upd, cache.v_pages, v, cache.block_table, pos,
-                   name="paged_kv_write")
+            kp, ksc = apply(updq, cache.k_pages, cache.k_scale, k,
+                            cache.block_table, pos,
+                            name="paged_kv_write_quant")
+            vp, vsc = apply(updq, cache.v_pages, cache.v_scale, v,
+                            cache.block_table, pos,
+                            name="paged_kv_write_quant")
+        else:
+            def upd(pages, new, table, p):
+                return write_pages(pages, new, table, p)
+
+            kp = apply(upd, cache.k_pages, k, cache.block_table, pos,
+                       name="paged_kv_write")
+            vp = apply(upd, cache.v_pages, v, cache.block_table, pos,
+                       name="paged_kv_write")
+            ksc = vsc = None
         from ..serving.kv_cache import ContextPagedLayerCache
         is_ctx = isinstance(cache, ContextPagedLayerCache)
-        new_cache = type(cache)(kp, vp, cache.block_table)
+        new_cache = type(cache)(kp, vp, cache.block_table, ksc, vsc,
+                                cache.lora_a, cache.lora_b, cache.lora_ids)
         S = x.shape[1]
         if S > 1 and not is_ctx:
             from ..ops.attention import scaled_dot_product_attention
@@ -274,17 +323,35 @@ class GPTAttention(Layer):
             # page-resident position <= pos + i, not just its own
             # chunk. Same gather + additive-mask construction as the
             # S == 1 decode fallback, one row of mask per chunk row.
+            def _ctx_mask(n_cols, p):
+                cols = jnp.arange(n_cols, dtype=jnp.int32)
+                rows = (p[:, None].astype(jnp.int32)
+                        + jnp.arange(S, dtype=jnp.int32)[None, :])
+                return jnp.where(
+                    cols[None, None, :] <= rows[:, :, None],
+                    0.0, -1e30)[:, None]          # [B, 1, S, MB*bs]
+
+            if quant:
+                def attend_ctx_q(q_, kpages, kscales, vpages, vscales,
+                                 table, p):
+                    from ..ops.attention import sdpa_array
+                    gk = gather_pages_quant(kpages, kscales, table)
+                    gv = gather_pages_quant(vpages, vscales, table)
+                    mask = _ctx_mask(gk.shape[1], p)
+                    return sdpa_array(q_, gk, gv, mask=mask,
+                                      dropout_p=0.0, is_causal=False)
+
+                out = apply(attend_ctx_q, q, kp, ksc, vp, vsc,
+                            cache.block_table, pos,
+                            name="paged_context_attention_quant")
+                return out, new_cache
+
             def attend_ctx(q_, kpages, vpages, table, p):
                 from ..ops.attention import sdpa_array
                 from ..serving.kv_cache import gather_pages as _gp
                 gk = _gp(kpages, table)
                 gv = _gp(vpages, table)
-                cols = jnp.arange(gk.shape[1], dtype=jnp.int32)
-                rows = (p[:, None].astype(jnp.int32)
-                        + jnp.arange(S, dtype=jnp.int32)[None, :])
-                mask = jnp.where(
-                    cols[None, None, :] <= rows[:, :, None],
-                    0.0, -1e30)[:, None]          # [B, 1, S, MB*bs]
+                mask = _ctx_mask(gk.shape[1], p)
                 return sdpa_array(q_, gk, gv, mask=mask, dropout_p=0.0,
                                   is_causal=False)
 
@@ -297,6 +364,34 @@ class GPTAttention(Layer):
         # FLAGS_pallas_paged_decode -> the gather+SDPA composition)
         from ..ops import pallas as pallas_ops
         use_kernel = pallas_ops.kernel_enabled("paged_decode")
+
+        def _decode_mask(n_cols, p):
+            cols = jnp.arange(n_cols, dtype=jnp.int32)
+            # additive key mask [B, 1, 1, Lk]: slot b sees written
+            # positions 0..p[b] (its current token included)
+            return jnp.where(cols[None, :] <= p[:, None].astype(jnp.int32),
+                             0.0, -1e30)[:, None, None, :]
+
+        if quant:
+            def attend_q(q_, kpages, kscales, vpages, vscales, table, p):
+                if use_kernel:
+                    from ..ops.pallas.paged_decode import \
+                        paged_decode_attention_quant
+                    o = paged_decode_attention_quant(
+                        q_[:, 0], kpages, kscales, vpages, vscales,
+                        table, p.astype(jnp.int32),
+                        scale=1.0 / math.sqrt(q_.shape[-1]))
+                    return o[:, None]
+                from ..ops.attention import sdpa_array
+                gk = gather_pages_quant(kpages, kscales, table)
+                gv = gather_pages_quant(vpages, vscales, table)
+                mask = _decode_mask(gk.shape[1], p)
+                return sdpa_array(q_, gk, gv, mask=mask, dropout_p=0.0,
+                                  is_causal=False)
+
+            out = apply(attend_q, q, kp, ksc, vp, vsc, cache.block_table,
+                        pos, name="paged_attention_quant")
+            return out, new_cache
 
         def attend(q_, kpages, vpages, table, p):
             if use_kernel:
@@ -311,11 +406,7 @@ class GPTAttention(Layer):
             from ..ops.attention import sdpa_array
             gk = gather_pages(kpages, table)
             gv = gather_pages(vpages, table)
-            cols = jnp.arange(gk.shape[1], dtype=jnp.int32)
-            # additive key mask [B, 1, 1, Lk]: slot b sees written
-            # positions 0..p[b] (its current token included)
-            mask = jnp.where(cols[None, :] <= p[:, None].astype(jnp.int32),
-                             0.0, -1e30)[:, None, None, :]
+            mask = _decode_mask(gk.shape[1], p)
             return sdpa_array(q_, gk, gv, mask=mask, dropout_p=0.0,
                               is_causal=False)
 
@@ -417,30 +508,50 @@ class GPTMoEDecoderLayer(GPTDecoderLayer):
         return out, self.moe.moe_vec
 
 
-def _paged_scan_body(template, x, cache_slices, extras):
+def _paged_body(cls, template, x, cache_slices, extras, scan_in):
+    """Shared core of the paged scan bodies: rebuild one layer's cache
+    view from the scanned slices and run the block.
+
+    ``cache_slices`` is ``(k, v)`` or — quantized cache
+    (``FLAGS_serve_kv_quant``) — ``(k, v, k_scale, v_scale)``;
+    ``extras`` is ``(block_table, pos)`` plus, when the LoRA ``scan_in``
+    pools ride along, the broadcast ``lora_ids``. Layout changes key
+    distinct traces via the scan token's ``(n_cache, n_scan_in,
+    len(extra))`` components."""
+    if len(cache_slices) == 4:
+        k_pages, v_pages, ksc, vsc = cache_slices
+    else:
+        (k_pages, v_pages), ksc, vsc = cache_slices, None, None
+    block_table, pos = extras[0], extras[1]
+    la = lb = ids = None
+    if scan_in:
+        la, lb = scan_in
+        ids = extras[2]
+    x, c = template(x, cls(k_pages, v_pages, block_table, ksc, vsc,
+                           la, lb, ids), pos=pos)
+    if ksc is not None:
+        return x, (c.k_pages, c.v_pages, c.k_scale, c.v_scale)
+    return x, (c.k_pages, c.v_pages)
+
+
+def _paged_scan_body(template, x, cache_slices, extras, scan_in=()):
     """scan_layers_with_cache adapter for GPT blocks: one layer's page
     pools in, the block's updated pools out (module-level so its identity
     is stable in the eager jit-cache token)."""
     from ..serving.kv_cache import PagedLayerCache
-    k_pages, v_pages = cache_slices
-    block_table, pos = extras
-    x, c = template(x, PagedLayerCache(k_pages, v_pages, block_table),
-                    pos=pos)
-    return x, (c.k_pages, c.v_pages)
+    return _paged_body(PagedLayerCache, template, x, cache_slices,
+                       extras, scan_in)
 
 
-def _paged_scan_body_ctx(template, x, cache_slices, extras):
+def _paged_scan_body_ctx(template, x, cache_slices, extras, scan_in=()):
     """Context-prefill twin of :func:`_paged_scan_body` (ISSUE 15): the
     layer cache is the :class:`ContextPagedLayerCache` marker, so S>1
     chunks attend over prior pages. A distinct module-level function —
     its identity keys the scan cache token, so the two attention paths
     can never share a trace."""
     from ..serving.kv_cache import ContextPagedLayerCache
-    k_pages, v_pages = cache_slices
-    block_table, pos = extras
-    x, c = template(x, ContextPagedLayerCache(k_pages, v_pages,
-                                              block_table), pos=pos)
-    return x, (c.k_pages, c.v_pages)
+    return _paged_body(ContextPagedLayerCache, template, x, cache_slices,
+                       extras, scan_in)
 
 
 class GPTModel(Layer):
@@ -641,25 +752,52 @@ class GPTModel(Layer):
         is_ctx = isinstance(caches, ContextPagedCacheView)
         layer_cls = ContextPagedLayerCache if is_ctx else PagedLayerCache
         body = _paged_scan_body_ctx if is_ctx else _paged_scan_body
+        quant = caches.k_scale is not None
+        lora = caches.lora_a is not None
         eligible = self.cfg.scan_layers and can_scan_layers(self.layers)
         if eligible and get_flag("scan_decode"):
-            x, (new_k, new_v) = scan_layers_with_cache(
-                self.layers, x, (caches.k, caches.v),
-                caches.block_table, cache_pos,
-                body_call=body, name="gpt_paged_scan")
+            cache_arrs = (caches.k, caches.v)
+            if quant:
+                cache_arrs += (caches.k_scale, caches.v_scale)
+            # LoRA pools are [L, ...] per-layer state the decode step
+            # READS but never writes: scanned-over inputs, no outputs
+            scan_in = (caches.lora_a, caches.lora_b) if lora else ()
+            extras = (caches.block_table, cache_pos)
+            if lora:
+                extras += (caches.lora_ids,)
+            x, new = scan_layers_with_cache(
+                self.layers, x, cache_arrs, *extras,
+                body_call=body, scan_in=scan_in, name="gpt_paged_scan")
             x = self.final_norm(x)
-            return x, PagedCacheView(new_k, new_v, caches.block_table)
+            if quant:
+                return x, PagedCacheView(new[0], new[1],
+                                         caches.block_table,
+                                         new[2], new[3])
+            return x, PagedCacheView(new[0], new[1], caches.block_table)
         if eligible:
             note_scan_fallback("scan_decode_disabled", "gpt")
         from ..tensor.manipulation import stack as tstack
-        ks, vs = [], []
+        ks, vs, kscs, vscs = [], [], [], []
         for i, blk in enumerate(self.layers):
-            layer_cache = layer_cls(caches.k[i], caches.v[i],
-                                    caches.block_table)
+            layer_cache = layer_cls(
+                caches.k[i], caches.v[i], caches.block_table,
+                caches.k_scale[i] if quant else None,
+                caches.v_scale[i] if quant else None,
+                caches.lora_a[i] if lora else None,
+                caches.lora_b[i] if lora else None,
+                caches.lora_ids if lora else None)
             x, c = blk(x, layer_cache, pos=cache_pos)
             ks.append(c.k_pages)
             vs.append(c.v_pages)
+            if quant:
+                kscs.append(c.k_scale)
+                vscs.append(c.v_scale)
         x = self.final_norm(x)
+        if quant:
+            return x, PagedCacheView(
+                tstack(ks, axis=0), tstack(vs, axis=0),
+                caches.block_table,
+                tstack(kscs, axis=0), tstack(vscs, axis=0))
         return x, PagedCacheView(tstack(ks, axis=0), tstack(vs, axis=0),
                                  caches.block_table)
 

@@ -319,7 +319,7 @@ def scan_layers(blocks, x, *extra, policy=None, use_recompute: bool = False,
 
 
 def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
-                           name: str = "scan_layers_cache"):
+                           scan_in=(), name: str = "scan_layers_cache"):
     """Run ``x`` through ``blocks`` as ONE ``jax.lax.scan`` while
     threading per-layer cache state — the decode-time counterpart of
     :func:`scan_layers` (the paged-KV serving path, ISSUE 6).
@@ -337,6 +337,14 @@ def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
     ``(x, new_cache_slices)`` with ``new_cache_slices`` matching
     ``cache``'s structure and per-layer shapes. Pass a module-level
     function — its identity rides the eager jit-cache token.
+
+    ``scan_in``: per-layer stacked arrays (``[L, ...]``) that scan as
+    INPUTS ONLY — each layer sees its slice but no updated slice is
+    carried out (the serving LoRA pools: per-layer adapter weights that
+    the decode step reads but never writes). When non-empty,
+    ``body_call`` is invoked with a fifth argument
+    ``(template, x, cache_slices, extras, scan_in_slices)``; when empty
+    the four-argument form is kept, so existing bodies are untouched.
 
     Eval-mode only (decode never trains): a training-mode template is
     rejected rather than silently dropping dropout randomness.
@@ -360,6 +368,7 @@ def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
     per_block = [dict(b.named_parameters()) for b in blocks]
     flat_params = [pb[n] for n in names for pb in per_block]
     n_cache = len(cache)
+    n_scan_in = len(scan_in)
 
     SCAN_STATS["scan_calls"] += 1
 
@@ -369,7 +378,8 @@ def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
             n: jnp.stack(arrs[i * num_layers:(i + 1) * num_layers], axis=0)
             for i, n in enumerate(names)}
         cache_raw = arrs[n_p:n_p + n_cache]
-        extra_raw = arrs[n_p + n_cache:]
+        scan_in_raw = arrs[n_p + n_cache:n_p + n_cache + n_scan_in]
+        extra_raw = arrs[n_p + n_cache + n_scan_in:]
         # same stacked-layout TP pins as the training scan (leading layer
         # axis replicated); no-op without an active mesh
         from ..distributed.spmd import constrain
@@ -380,25 +390,35 @@ def scan_layers_with_cache(blocks, x, cache, *extra, body_call,
 
         def body(carry, xs):
             SCAN_STATS["body_traces"] += 1
-            p_slice, cache_slice = xs
+            p_slice, cache_slice = xs[0], xs[1]
+            extras_t = tuple(Tensor(e) if hasattr(e, "dtype") else e
+                             for e in extra_raw)
             with bind_(template, p_slice, None):
-                out, new_cache = body_call(
-                    template, Tensor(carry),
-                    tuple(Tensor(c) for c in cache_slice),
-                    tuple(Tensor(e) if hasattr(e, "dtype") else e
-                          for e in extra_raw))
+                if n_scan_in:
+                    out, new_cache = body_call(
+                        template, Tensor(carry),
+                        tuple(Tensor(c) for c in cache_slice),
+                        extras_t,
+                        tuple(Tensor(s) for s in xs[2]))
+                else:
+                    out, new_cache = body_call(
+                        template, Tensor(carry),
+                        tuple(Tensor(c) for c in cache_slice),
+                        extras_t)
             out = out._data if isinstance(out, Tensor) else out
             new_cache = tuple(c._data if isinstance(c, Tensor) else c
                               for c in new_cache)
             return out.astype(carry.dtype), new_cache
 
-        y, new_cache_stacked = jax.lax.scan(
-            body, x_arr, (p_stacked, tuple(cache_raw)))
+        xs = (p_stacked, tuple(cache_raw))
+        if n_scan_in:
+            xs = xs + (tuple(scan_in_raw),)
+        y, new_cache_stacked = jax.lax.scan(body, x_arr, xs)
         return (y,) + tuple(new_cache_stacked)
 
     x_t = x if isinstance(x, Tensor) else Tensor(x)
     token = ("scan_layers_cache", name, id(template), num_layers, n_cache,
-             len(extra), id(body_call), _config_sig(template))
-    out = apply(_scan_fn, x_t, *flat_params, *cache, *extra, name=name,
-                _cache_token=token)
+             n_scan_in, len(extra), id(body_call), _config_sig(template))
+    out = apply(_scan_fn, x_t, *flat_params, *cache, *scan_in, *extra,
+                name=name, _cache_token=token)
     return out[0], tuple(out[1:])

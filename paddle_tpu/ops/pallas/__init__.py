@@ -32,6 +32,8 @@ paged_decode        FLAGS_pallas_paged_decode   gather_pages + masked
                                                 SDPA (models/gpt.py)
 int8_matmul         FLAGS_pallas_int8           slim dequant-to-float /
                                                 XLA int8 dot
+bgmv                FLAGS_pallas_bgmv           XLA adapter gather +
+                                                einsum shrink/expand
 ==================  ==========================  =========================
 """
 
@@ -44,7 +46,9 @@ from ...core.flags import get_flag
 
 __all__ = [
     "flash_attention", "chunked_ce_loss", "paged_decode_attention",
+    "paged_decode_attention_quant",
     "int8_matmul", "int8_linear", "int8_amp_linear", "quantize_per_channel",
+    "bgmv", "bgmv_xla",
     "kernels", "kernel_enabled", "note_fallback", "backend_supported",
     "PALLAS_STATS", "reset_pallas_stats",
 ]
@@ -66,6 +70,8 @@ _REGISTRY = {
                                             "(models/gpt.py)"),
     "int8_matmul": ("pallas_int8", "weight dequantize-to-float matmul / "
                                    "XLA int8 dot (slim.QuantizedLinear)"),
+    "bgmv": ("pallas_bgmv", "XLA adapter gather + einsum shrink/expand "
+                            "(ops.pallas.bgmv.bgmv_xla)"),
 }
 
 
@@ -167,6 +173,11 @@ def paged_decode_attention(*args, **kw):
     return _pd(*args, **kw)
 
 
+def paged_decode_attention_quant(*args, **kw):
+    from .paged_decode import paged_decode_attention_quant as _pd
+    return _pd(*args, **kw)
+
+
 def int8_matmul(*args, **kw):
     from .quant_matmul import int8_matmul as _mm
     return _mm(*args, **kw)
@@ -185,3 +196,13 @@ def int8_amp_linear(*args, **kw):
 def quantize_per_channel(*args, **kw):
     from .quant_matmul import quantize_per_channel as _q
     return _q(*args, **kw)
+
+
+def bgmv(*args, **kw):
+    from .bgmv import bgmv as _b
+    return _b(*args, **kw)
+
+
+def bgmv_xla(*args, **kw):
+    from .bgmv import bgmv_xla as _b
+    return _b(*args, **kw)

@@ -44,7 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import _compat  # noqa: F401  (pltpu.CompilerParams shim)
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_decode_attention_quant"]
 
 NEG_INF = -1e30
 
@@ -53,8 +53,13 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _decode_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, bs, H, D):
+def _decode_kernel(tbl_ref, pos_ref, q_ref, *refs, scale, bs, H, D,
+                   quant=False):
+    if quant:
+        # int8 pools ride with their per-(row, head) f32 scale blocks
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     b, j = pl.program_id(0), pl.program_id(1)
     nj = pl.num_programs(1)
 
@@ -74,6 +79,11 @@ def _decode_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)                 # [H, D]
         k = k_ref[0].astype(jnp.float32)                 # [bs, H, D]
         v = v_ref[0].astype(jnp.float32)
+        if quant:
+            # identical math to kv_cache.dequant_pages, so the kernel
+            # stays token-exact against the XLA gather fallback
+            k = k * ks_ref[0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0].astype(jnp.float32)[..., None]
         # s[h, c] = q[h] . k[c, h] — heads are the batch dimension
         s = jax.lax.dot_general(
             q, k, (((1,), (2,)), ((0,), (1,))),
@@ -146,3 +156,53 @@ def paged_decode_attention(q, k_pages, v_pages, block_table, pos, *,
         interpret=_interpret(),
     )(block_table.astype(jnp.int32), pos.astype(jnp.int32),
       q, k_pages, v_pages)
+
+
+def paged_decode_attention_quant(q, k_pages, k_scales, v_pages, v_scales,
+                                 block_table, pos, *, scale: float):
+    """Decode attention over an int8-quantized paged pool
+    (``FLAGS_serve_kv_quant=int8``).
+
+    Same contract as :func:`paged_decode_attention`, plus the parallel
+    f32 scale pools ``k_scales``/``v_scales`` ``[P, bs, H]``. The scale
+    blocks ride the SAME block-table index maps as their pages, so the
+    dequantize (``int8 * scale``) happens in VMEM right before the
+    existing online-softmax sweep — the dequantized context never exists
+    in HBM. Must match ``kv_cache.gather_pages_quant`` + masked SDPA
+    token-exactly (same dequant math, f32 accumulation).
+    """
+    B, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                           # table, pos
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, tbl, p: (b, 0, 0)),
+            pl.BlockSpec((1, bs, H, D),
+                         lambda b, j, tbl, p: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, H),
+                         lambda b, j, tbl, p: (tbl[b, j], 0, 0)),
+            pl.BlockSpec((1, bs, H, D),
+                         lambda b, j, tbl, p: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, H),
+                         lambda b, j, tbl, p: (tbl[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j, tbl, p: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 8), jnp.float32),
+            pltpu.VMEM((H, 8), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale), bs=bs,
+                          H=H, D=D, quant=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(block_table.astype(jnp.int32), pos.astype(jnp.int32),
+      q, k_pages, k_scales, v_pages, v_scales)

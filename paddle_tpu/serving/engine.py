@@ -133,6 +133,18 @@ class ServingConfig:
     #: params and KV, which is what serves models beyond one chip.
     #: None (default) = single-chip engine, bit-compatible.
     mesh: Optional[object] = None
+    #: multi-tenant LoRA (ISSUE 17): > 0 builds a serving.lora
+    #: LoRAManager with this many loadable adapter rows and threads the
+    #: stacked pools + per-slot adapter ids through every serving
+    #: program (the bgmv path). 0 (default) = no manager, program
+    #: signatures and dispatch args unchanged — bit-compatible.
+    lora_adapters: int = 0
+    lora_rank: int = 8
+    #: per-tenant admission cap: at most this many slots may hold
+    #: requests of one tenant at a time (excess waits in the queue while
+    #: OTHER tenants admit past it — the fairness floor). None
+    #: (default) = no cap, admission order is byte-identical FIFO.
+    tenant_quota: Optional[int] = None
 
     def resolve(self, model_max_positions: Optional[int]) -> None:
         if self.queue_policy not in QUEUE_POLICIES:
@@ -210,11 +222,23 @@ class ServingEngine:
             from ..distributed.spmd import shard_serving_cache
             shard_serving_cache(self.cache, self.mesh)
         self.buckets = BucketTable(c.prefill_buckets, c.batch_buckets)
+        self.lora = None
+        if c.lora_adapters > 0:
+            from .lora import LoRAManager
+            # pools sized to the fused-QKV delta (3*H*D out features) —
+            # built BEFORE the scheduler, which acquires/releases
+            # adapter references at the slot lifecycle choke points
+            self.lora = LoRAManager(
+                cfg.num_layers, cfg.hidden_size,
+                3 * cfg.num_heads * cfg.head_dim,
+                max_adapters=c.lora_adapters, rank=c.lora_rank)
         self.scheduler = Scheduler(self.cache, self.buckets,
                                    max_queue=c.max_queue, clock=clock,
                                    max_seq_len=c.max_context_len,
                                    policy=c.queue_policy,
-                                   on_event=self._on_request_event)
+                                   on_event=self._on_request_event,
+                                   tenant_quota=c.tenant_quota,
+                                   lora=self.lora)
         self._overload = (OverloadDetector(
             c.overload_threshold_s, alpha=c.overload_alpha,
             exit_frac=c.overload_exit_frac)
@@ -243,6 +267,10 @@ class ServingEngine:
             self.prefix_cache = RadixPrefixCache(self.cache)
             self.cache.prefix_cache = self.prefix_cache
         self._prefix_published: Dict[str, float] = {}
+        #: delta-publish cursors for the per-tenant counters (same
+        #: pattern as the prefix metrics: host stats are the source of
+        #: truth, the registry sees monotone deltas)
+        self._quota_published: Dict[str, int] = {}
         self._drain_latch: Optional[DrainLatch] = None
         self._draining = False
         self._drained = False
@@ -342,6 +370,15 @@ class ServingEngine:
                            if self._overload is not None else False),
             "watchdog_tripped": self._watchdog_tripped,
         }
+        if self.lora is not None:
+            d["lora"] = {
+                "loaded": self.lora.loaded(),
+                "swaps": self.lora.swaps,
+                "refcounts": {n: self.lora.refcount(n)
+                              for n in self.lora.loaded()},
+            }
+        if self.scheduler.tenant_quota is not None:
+            d["tenant_deferrals"] = dict(self.scheduler.tenant_deferrals)
         if self._slo_avail is not None:
             d["slo_availability"] = self._slo_avail.snapshot()
         if self._slo_deadline is not None:
@@ -385,20 +422,40 @@ class ServingEngine:
             finally:
                 dist_env.set_mesh(prev)
 
-    def _fwd(self, params, ids, k, v, table, pos, ctx: bool = False):
+    def _fwd(self, params, ids, k, v, table, pos, lora=None,
+             ctx: bool = False):
         """Pure model forward over the paged view (traced inside the
         prefill/decode programs). ``ctx=True`` selects the
         CONTEXT-prefill attention path (ISSUE 15): S>1 chunks attend
         over everything already in the pages, not just themselves —
         chunked-prefill continuations, prefix-hit tails and speculative
-        verify windows all run through it."""
+        verify windows all run through it.
+
+        Quantized pools (FLAGS_serve_kv_quant) arrive as
+        ``(pages, scales)`` tuples and leave the same way, so
+        ``cache.update`` keeps the tuple structure; ``lora`` is the
+        optional ``(a_pool, b_pool, per_slot_rows)`` triple of a
+        multi-tenant engine (ISSUE 17) — the view carries it down to
+        the attention blocks' bgmv delta."""
         cls = ContextPagedCacheView if ctx else PagedCacheView
-        view = cls(Tensor(k), Tensor(v), Tensor(table))
+        quant = isinstance(k, tuple)
+        wrap = lambda t: None if t is None else Tensor(t)
+        la, lb, rows = lora if lora is not None else (None, None, None)
+        if quant:
+            view = cls(Tensor(k[0]), Tensor(v[0]), Tensor(table),
+                       Tensor(k[1]), Tensor(v[1]), wrap(la), wrap(lb),
+                       wrap(rows))
+        else:
+            view = cls(Tensor(k), Tensor(v), Tensor(table), None, None,
+                       wrap(la), wrap(lb), wrap(rows))
         with bind(self.model, params, dict(self.buffers)), no_grad(), \
                 trace_rng(jax.random.key(0)):
             logits, new = self.model(Tensor(ids), caches=view,
                                      cache_pos=Tensor(pos))
         unw = lambda t: t._data if isinstance(t, Tensor) else t
+        if quant:
+            return (unw(logits), (unw(new.k), unw(new.k_scale)),
+                    (unw(new.v), unw(new.v_scale)))
         return unw(logits), unw(new.k), unw(new.v)
 
     def _attribute(self, kind: str, lowered, compiled) -> None:
@@ -452,9 +509,12 @@ class ServingEngine:
             return prog
 
         def decode_fn(params, k, v, table, pos, tokens, active, rng,
-                      temps, top_ks, top_ps, poison):
+                      temps, top_ks, top_ps, poison, *lora):
+            # *lora is (a_pool, b_pool, rows) on a multi-tenant engine
+            # and EMPTY otherwise — the 12-arg signature and the traced
+            # program are unchanged when FLAGS/config leave LoRA off
             logits, k, v = self._fwd(params, tokens[:, None], k, v,
-                                     table, pos)
+                                     table, pos, lora=lora or None)
             # poison is all-zeros outside chaos (bit-transparent); a NaN
             # entry models a slot whose forward went non-finite. `ok` is
             # the per-slot fault-isolation flag: one bad request fails
@@ -478,9 +538,30 @@ class ServingEngine:
                           jnp.ones((B,), jnp.float32),
                           jnp.zeros((B,), jnp.int32),
                           jnp.ones((B,), jnp.float32),
-                          jnp.zeros((B,), jnp.float32)))
+                          jnp.zeros((B,), jnp.float32))
+                         + self._lora_sig(B))
         self._programs[key] = prog
         return prog
+
+    def _lora_sig(self, n: int) -> tuple:
+        """Compile-time LoRA argument suffix for an ``n``-row program:
+        the stacked pools + an all-zero (= zero-adapter) row vector.
+        Empty on a non-LoRA engine — signatures stay pinned."""
+        if self.lora is None:
+            return ()
+        return (self.lora.a, self.lora.b, jnp.zeros((n,), jnp.int32))
+
+    def _lora_args(self, states) -> tuple:
+        """Dispatch-time LoRA argument suffix: the LIVE pools (hot-swaps
+        between steps are just new arguments — never a recompile) and
+        each row's adapter pool index (empty slots / base requests ride
+        the zero adapter, row 0)."""
+        if self.lora is None:
+            return ()
+        rows = self.lora.rows_for(
+            [st.request.adapter if st is not None else None
+             for st in states])
+        return (self.lora.a, self.lora.b, rows)
 
     def _get_prefill(self, nb: int, sp: int) -> AOTProgram:
         key = ("prefill", nb, sp)
@@ -489,9 +570,10 @@ class ServingEngine:
             return prog
 
         def prefill_fn(params, k, v, table, ids, lens, rng, temps,
-                       top_ks, top_ps, poison):
+                       top_ks, top_ps, poison, *lora):
             pos = jnp.zeros((nb,), jnp.int32)
-            logits, k, v = self._fwd(params, ids, k, v, table, pos)
+            logits, k, v = self._fwd(params, ids, k, v, table, pos,
+                                     lora=lora or None)
             last = jnp.take_along_axis(
                 logits, (lens - 1).astype(jnp.int32)[:, None, None],
                 axis=1)[:, 0, :]
@@ -512,7 +594,8 @@ class ServingEngine:
                           jnp.ones((nb,), jnp.float32),
                           jnp.zeros((nb,), jnp.int32),
                           jnp.ones((nb,), jnp.float32),
-                          jnp.zeros((nb,), jnp.float32)))
+                          jnp.zeros((nb,), jnp.float32))
+                         + self._lora_sig(nb))
         self._programs[key] = prog
         return prog
 
@@ -528,9 +611,9 @@ class ServingEngine:
             return prog
 
         def prefill_ctx_fn(params, k, v, table, ids, lens, pos, rng,
-                           temps, top_ks, top_ps, poison):
+                           temps, top_ks, top_ps, poison, *lora):
             logits, k, v = self._fwd(params, ids, k, v, table, pos,
-                                     ctx=True)
+                                     lora=lora or None, ctx=True)
             last = jnp.take_along_axis(
                 logits, (lens - 1).astype(jnp.int32)[:, None, None],
                 axis=1)[:, 0, :]
@@ -553,7 +636,8 @@ class ServingEngine:
                           jnp.ones((nb,), jnp.float32),
                           jnp.zeros((nb,), jnp.int32),
                           jnp.ones((nb,), jnp.float32),
-                          jnp.zeros((nb,), jnp.float32)))
+                          jnp.zeros((nb,), jnp.float32))
+                         + self._lora_sig(nb))
         self._programs[key] = prog
         return prog
 
@@ -580,8 +664,9 @@ class ServingEngine:
         S = self._spec_k + 1
 
         def verify_fn(params, k, v, table, pos, ids, active, rng,
-                      temps, top_ks, top_ps, poison):
+                      temps, top_ks, top_ps, poison, *lora):
             logits, k, v = self._fwd(params, ids, k, v, table, pos,
+                                     lora=lora or None,
                                      ctx=True)                # [B,S,V]
             row0 = logits[:, 0, :] + poison[:, None]
             ok_rows = jnp.isfinite(logits).all(axis=-1)       # [B,S]
@@ -626,7 +711,8 @@ class ServingEngine:
                           jnp.ones((B,), jnp.float32),
                           jnp.zeros((B,), jnp.int32),
                           jnp.ones((B,), jnp.float32),
-                          jnp.zeros((B,), jnp.float32)))
+                          jnp.zeros((B,), jnp.float32))
+                         + self._lora_sig(B))
         self._programs[key] = prog
         return prog
 
@@ -676,6 +762,11 @@ class ServingEngine:
         span-tree closure. Only fires on lifecycle events — never per
         step (the zero-overhead pin)."""
         self._requests_counter().inc(event=outcome)
+        if st.request.tenant:
+            get_registry().counter(
+                "serve_tenant_requests_total",
+                "serving requests by tenant and lifecycle event").inc(
+                tenant=st.request.tenant, event=outcome)
         if outcome != "completed":
             self._flight_event(
                 "request_failed" if outcome == "failed"
@@ -752,6 +843,18 @@ class ServingEngine:
                     "overload", queue_depth=self.scheduler.queue_depth,
                     ewma_s=self._overload.ewma_s,
                     threshold_s=self._overload.threshold_s)
+        if request.adapter and (
+                self.lora is None
+                or self.lora.row(request.adapter) is None):
+            # fail fast at the door: a request naming an unknown
+            # adapter can never decode (the scheduler re-checks at
+            # admission, covering a hot-unload that races the queue)
+            self._requests_counter().inc(event="rejected")
+            raise ValueError(
+                f"adapter {request.adapter!r} is not loaded"
+                + ("" if self.lora is not None
+                   else " (engine has no LoRA manager; set "
+                        "ServingConfig.lora_adapters)"))
         try:
             st = self.scheduler.submit(request)
         except ServerOverloaded:
@@ -790,6 +893,12 @@ class ServingEngine:
                 tr.mark_anomaly("chaos",
                                 chaos_site="serve.request.poison")
         self._requests_counter().inc(event="submitted")
+        if request.tenant:
+            # emits-metrics: serve_tenant_requests_total
+            get_registry().counter(
+                "serve_tenant_requests_total",
+                "serving requests by tenant and lifecycle event").inc(
+                tenant=request.tenant, event="submitted")
         self._publish_gauges()
         return st
 
@@ -1190,7 +1299,8 @@ class ServingEngine:
         # the tripped dispatch's pool writes died with its thread)
         toks, ok, new_k, new_v = self._guarded_dispatch(
             "prefill", prog,
-            args + (temps, tks, tps, self._poison_array(states)))
+            args + (temps, tks, tps, self._poison_array(states))
+            + self._lora_args(states))
         self.cache.update(new_k, new_v)
         toks = np.asarray(toks)
         ok = np.asarray(ok)
@@ -1303,7 +1413,8 @@ class ServingEngine:
                 (self.params, self.cache.k, self.cache.v,
                  self._decode_table(per_slot), jnp.asarray(pos),
                  jnp.asarray(ids), jnp.asarray(active), self._next_key(),
-                 temps, tks, tps, self._poison_array(per_slot)),
+                 temps, tks, tps, self._poison_array(per_slot))
+                + self._lora_args(per_slot),
                 hang=hang)
         self.cache.update(new_k, new_v)
         tok0 = np.asarray(tok0)
@@ -1429,7 +1540,8 @@ class ServingEngine:
             (self.params, self.cache.k, self.cache.v,
              self._decode_table(per_slot), jnp.asarray(pos),
              jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
-             temps, tks, tps, self._poison_array(per_slot)),
+             temps, tks, tps, self._poison_array(per_slot))
+            + self._lora_args(per_slot),
             hang=hang)
         self.cache.update(new_k, new_v)
         toks = np.asarray(toks)
@@ -1552,6 +1664,25 @@ class ServingEngine:
         reg.gauge("serve_kv_pages_in_use",
                   "allocated KV pages (of the shared pool)").set(
             self.cache.allocator.pages_in_use)
+        if self.cache.quant:
+            # emits-metrics: serve_kv_quant_bytes_per_token
+            reg.gauge(
+                "serve_kv_quant_bytes_per_token",
+                "HBM bytes per cached token position under "
+                "FLAGS_serve_kv_quant (int8 pages + f32 per-head "
+                "scales)").set(float(self.cache.kv_bytes_per_token()))
+        if self.scheduler.tenant_quota is not None:
+            # delta-publish the per-tenant quota deferrals (prefix-
+            # metrics convention: scheduler counts, engine publishes)
+            for tenant, n in self.scheduler.tenant_deferrals.items():
+                delta = n - self._quota_published.get(tenant, 0)
+                if delta > 0:
+                    # emits-metrics: serve_tenant_quota_deferrals_total
+                    reg.counter(
+                        "serve_tenant_quota_deferrals_total",
+                        "admissions deferred by the per-tenant slot "
+                        "quota").inc(delta, tenant=tenant)
+                    self._quota_published[tenant] = n
         if self.prefix_cache is not None:
             self._publish_prefix_metrics(reg)
 
@@ -1651,6 +1782,14 @@ class ServingEngine:
             "spec_proposed": self._stats["spec_proposed"],
             "spec_accepted": self._stats["spec_accepted"],
             "spec_rolled_back": self._stats["spec_rolled_back"],
+            # multi-tenant serving (ISSUE 17)
+            "kv_bytes_per_token": self.cache.kv_bytes_per_token(),
+            "kv_quant": self.cache.quant or None,
+            "lora_adapters_loaded": (self.lora.num_loaded
+                                     if self.lora is not None else 0),
+            "lora_swaps": (self.lora.swaps
+                           if self.lora is not None else 0),
+            "quota_deferred": sstats.get("quota_deferred", 0),
         }
 
     def shutdown(self) -> None:

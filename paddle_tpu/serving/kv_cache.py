@@ -40,6 +40,7 @@ import numpy as np
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView",
            "PagedLayerCache", "ContextPagedCacheView",
            "ContextPagedLayerCache", "write_pages", "gather_pages",
+           "write_pages_quant", "gather_pages_quant", "dequant_pages",
            "blocks_needed"]
 
 #: physical page 0 is never allocated: it is the shared scratch target for
@@ -56,20 +57,41 @@ class PagedCacheView(NamedTuple):
     """Model-level traced view of the cache: what ``GPTModel.forward``
     receives as ``caches``. ``k``/``v`` are layer-stacked pools
     ``[L, P, bs, H, D]``; ``block_table`` is ``[B, MB]`` int32. Being a
-    NamedTuple it is a pytree — it flows through jit/scan unchanged."""
+    NamedTuple it is a pytree — it flows through jit/scan unchanged.
+
+    Optional trailing fields (all default ``None`` so every pre-existing
+    3-arg construction is unchanged): ``k_scale``/``v_scale`` are the
+    ``[L, P, bs, H]`` f32 scale pools of a quantized cache
+    (``FLAGS_serve_kv_quant``); ``lora_a``/``lora_b`` are per-layer
+    stacked LoRA pools ``[L, A, r, E]`` / ``[L, A, r, O]`` and
+    ``lora_ids`` the ``[B]`` int32 per-slot adapter rows (serving.lora).
+    """
 
     k: object
     v: object
     block_table: object
+    k_scale: object = None
+    v_scale: object = None
+    lora_a: object = None
+    lora_b: object = None
+    lora_ids: object = None
 
 
 class PagedLayerCache(NamedTuple):
     """One layer's slice of the view (``[P, bs, H, D]`` pools), handed to
-    ``GPTAttention.forward`` by both the scan body and the loop layout."""
+    ``GPTAttention.forward`` by both the scan body and the loop layout.
+    Optional trailing fields mirror :class:`PagedCacheView` (per-layer
+    slices: ``[P, bs, H]`` scales, ``[A, r, E]``/``[A, r, O]`` LoRA
+    pools)."""
 
     k_pages: object
     v_pages: object
     block_table: object
+    k_scale: object = None
+    v_scale: object = None
+    lora_a: object = None
+    lora_b: object = None
+    lora_ids: object = None
 
 
 class ContextPagedCacheView(PagedCacheView):
@@ -112,6 +134,56 @@ def gather_pages(pages, block_table):
     """Gather a slot-contiguous context ``[B, MB*bs, H, D]`` out of the
     pool via the block table (the PagedAttention read)."""
     g = pages[block_table]                        # [B, MB, bs, H, D]
+    B, MB, bs, H, D = g.shape
+    return g.reshape(B, MB * bs, H, D)
+
+
+#: int8 quant range: symmetric, -127..127 (no -128 — keeps the scale
+#: inversion exact under negation)
+_QMAX = 127.0
+#: absmax floor so an all-zero token row quantizes to scale eps, not 0/0
+_QEPS = 1e-8
+
+
+def write_pages_quant(pages, scales, new, block_table, pos):
+    """Quantizing scatter (``FLAGS_serve_kv_quant=int8``): same indexing
+    as :func:`write_pages`, but ``new`` ``[B, S, H, D]`` is stored as
+    int8 in ``pages`` with a per-token-row, per-head absmax scale in the
+    parallel f32 pool ``scales`` ``[P, bs, H]``. Quantization happens at
+    write time — every token row is quantized exactly once, so pages can
+    move between slots (COW sharing, radix donation, ``truncate_slot``,
+    drain snapshots) without ever touching the payload: the scale rides
+    the same physical page index. Returns ``(pages, scales)``."""
+    bs = pages.shape[1]
+    mb = block_table.shape[1]
+    S = new.shape[1]
+    idx = pos[:, None].astype(jnp.int32) + \
+        jnp.arange(S, dtype=jnp.int32)[None, :]                  # [B, S]
+    blk_logical = jnp.minimum(idx // bs, mb - 1)
+    blk = jnp.take_along_axis(block_table, blk_logical, axis=1)  # [B, S]
+    blk = jnp.where(idx >= bs * mb, SCRATCH_PAGE, blk)
+    off = idx % bs
+    newf = new.astype(jnp.float32)                               # [B,S,H,D]
+    scale = jnp.maximum(jnp.max(jnp.abs(newf), axis=-1),
+                        _QEPS) / _QMAX                           # [B,S,H]
+    q = jnp.clip(jnp.round(newf / scale[..., None]),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return (pages.at[blk, off].set(q),
+            scales.at[blk, off].set(scale.astype(scales.dtype)))
+
+
+def dequant_pages(pages, scales):
+    """Dequantize an int8 pool (or any gathered slice of one) back to
+    f32: ``pages [..., H, D] * scales [..., H, None]``."""
+    return pages.astype(jnp.float32) * scales.astype(jnp.float32)[..., None]
+
+
+def gather_pages_quant(pages, scales, block_table):
+    """Quantized PagedAttention read: gather int8 pages and their scales
+    through the block table and dequantize to a slot-contiguous f32
+    ``[B, MB*bs, H, D]`` context (the XLA fallback the quant Pallas
+    decode kernel must match)."""
+    g = dequant_pages(pages[block_table], scales[block_table])
     B, MB, bs, H, D = g.shape
     return g.reshape(B, MB * bs, H, D)
 
@@ -200,13 +272,34 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  *, num_pages: int, block_size: int, max_slots: int,
                  max_blocks_per_slot: int, dtype=jnp.float32):
+        from ..core.flags import get_flag
         self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
         self.max_blocks_per_slot = int(max_blocks_per_slot)
+        self.dtype = jnp.dtype(dtype)
+        #: quant mode, read ONCE at construction (engine convention):
+        #: "" = full-precision pools (the flags-off oracle), "int8" =
+        #: int8 pools + parallel f32 per-(page, row, head) scale pools;
+        #: when quantized, self.k / self.v are (pages, scales) 2-tuples
+        #: — pytrees, so they flow through the existing jit arg slots.
+        self.quant = str(get_flag("serve_kv_quant") or "")
+        if self.quant not in ("", "int8"):
+            raise ValueError(
+                f"FLAGS_serve_kv_quant={self.quant!r}: supported modes "
+                "are '' (full precision) and 'int8'")
         shape = (num_layers, num_pages, block_size, num_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        if self.quant == "int8":
+            scale_shape = shape[:-1]              # [L, P, bs, H]
+            self.k = (jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(scale_shape, jnp.float32))
+            self.v = (jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(scale_shape, jnp.float32))
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
         self.allocator = BlockAllocator(num_pages)
         self._tables = np.full((max_slots, max_blocks_per_slot),
                                SCRATCH_PAGE, np.int32)
@@ -223,6 +316,18 @@ class PagedKVCache:
     # -- device-side --------------------------------------------------------
     def update(self, new_k, new_v) -> None:
         self.k, self.v = new_k, new_v
+
+    def kv_bytes_per_token(self) -> int:
+        """Device bytes ONE token position costs across all layers —
+        the capacity currency the kv-quant flag halves: int8 pays
+        ``H*D`` payload + ``H`` f32 scale bytes per pool, full precision
+        pays ``H*D*itemsize``."""
+        H, D, L = self.num_heads, self.head_dim, self.num_layers
+        if self.quant == "int8":
+            per_pool = H * D * 1 + H * 4
+        else:
+            per_pool = H * D * self.dtype.itemsize
+        return 2 * L * per_pool
 
     def table_array(self, rows: Optional[Sequence[Optional[int]]] = None):
         """Snapshot block tables as the step's int32 argument: all slots,

@@ -99,6 +99,15 @@ class LoadSpec:
     #: = the single shared pool above, byte-identical to pre-fleet
     #: specs.
     tenants: int = 0
+    #: multi-tenant LoRA traffic (ISSUE 17): > 0 = every tenanted
+    #: request names one of this many per-tenant adapters
+    #: ("tenant{t}/adapter{k}", k uniform from a fixed-seed SIDE
+    #: generator, so arming adapters perturbs none of the default
+    #: draws — arrivals/prompts/lengths replay exactly) and carries its
+    #: tenant name, reaching the per-tenant quota + batched-bgmv paths
+    #: from ``bench.py --serve``. Requires ``tenants > 0``. 0 (default)
+    #: = no adapter/tenant stamping, byte-identical to pre-LoRA specs.
+    adapter_pool: int = 0
 
 
 class TokenBucket:
@@ -165,7 +174,14 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
     """[(arrival_offset_s, Request), ...] sorted by arrival — the chosen
     arrival process, uniform prompt/output lengths, uniform random token
     ids, optional deadline/priority sampling — deterministic per seed."""
+    if spec.adapter_pool > 0 and spec.tenants <= 0:
+        raise ValueError("adapter_pool needs tenants > 0 (adapters are "
+                         "per-tenant)")
     rng = np.random.default_rng(spec.seed)
+    # adapter draws come from their own fixed-seed generator so arming
+    # adapter_pool leaves every draw from ``rng`` untouched (pinned)
+    arng = (np.random.default_rng(spec.seed ^ 0xADA9)
+            if spec.adapter_pool > 0 else None)
     arrivals = np.cumsum(_arrival_gaps(spec, rng))
     arrivals[0] = 0.0                       # first request at t=0
     out = []
@@ -207,12 +223,18 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
     for i in range(spec.num_requests):
         plen = int(rng.integers(lo_p, hi_p + 1))
         prompt = rng.integers(0, spec.vocab_size, (plen,)).astype(np.int32)
+        tenant = adapter = None
         if tenant_pools is not None:
             t = int(np.searchsorted(tenant_cdf, rng.random()))
-            pool = tenant_pools[min(t, len(tenant_pools) - 1)]
+            t = min(t, len(tenant_pools) - 1)
+            pool = tenant_pools[t]
             pi = int(np.searchsorted(prefix_cdf, rng.random()))
             prompt = np.concatenate([pool[min(pi, len(pool) - 1)],
                                      prompt])
+            if arng is not None:
+                tenant = f"tenant{t}"
+                adapter = (f"tenant{t}/adapter"
+                           f"{int(arng.integers(0, spec.adapter_pool))}")
         elif prefixes is not None:
             pi = int(np.searchsorted(prefix_cdf, rng.random()))
             prompt = np.concatenate([prefixes[min(pi, len(prefix_cdf)
@@ -229,7 +251,8 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
             prompt,
             max_new_tokens=int(rng.integers(lo_n, hi_n + 1)),
             sampling=spec.sampling or SamplingParams(),
-            deadline_s=deadline, priority=priority)))
+            deadline_s=deadline, priority=priority,
+            tenant=tenant, adapter=adapter)))
     return out
 
 

@@ -1,0 +1,226 @@
+"""Multi-tenant LoRA adapter management for the serving engine (ISSUE 17).
+
+Thousands of fine-tunes over one base model is the multi-tenant serving
+shape (Punica / S-LoRA): adapters are rank-r deltas on the fused QKV
+projection, small enough that N of them fit beside the base weights, and
+the bgmv kernel (``ops/pallas/bgmv.py``) applies a DIFFERENT adapter per
+batch slot inside the one compiled decode/prefill/verify program — so
+requests for different fine-tunes share a batch instead of a queue.
+
+:class:`LoRAManager` owns the device-resident pools:
+
+- stacked per-layer weights ``a [L, A, r, E]`` / ``b [L, A, r, 3*H*D]``
+  where row ``A`` indexes the adapter. **Row 0 is the reserved ZERO
+  adapter**: all-zero weights, so base-model requests ride the same
+  program with a delta of exactly 0.0 — mixing adapted and plain
+  requests costs nothing;
+- a name -> row map plus per-adapter slot refcounts: admission acquires
+  the adapter, slot release drops it, and :meth:`unload_adapter` refuses
+  while any slot still references the adapter (no in-flight request can
+  ever decode against freed or repurposed weights);
+- hot-swap through the checkpoint manifest machinery
+  (``distributed.checkpoint``): :meth:`load_adapter` with a ``path``
+  verifies the committed manifest first and validates every shape
+  BEFORE touching the pools — a torn or mismatched adapter checkpoint
+  leaves the pools exactly as they were (atomic load).
+
+The pools are ARGUMENTS of the compiled serving programs (like block
+tables and positions), so loading or unloading an adapter between steps
+never recompiles anything — the AOT-compile invariant of the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LoRAManager", "save_adapter_checkpoint"]
+
+
+def save_adapter_checkpoint(path: str, lora_a, lora_b) -> None:
+    """Commit adapter weights (``a [L, r, E]``, ``b [L, r, O]``) as a
+    manifest-covered checkpoint dir :meth:`LoRAManager.load_adapter` can
+    hot-swap in (synchronous: durable when the call returns)."""
+    from ..distributed import checkpoint as ckpt
+    ckpt.save({"lora_a": jnp.asarray(lora_a), "lora_b": jnp.asarray(lora_b)},
+              path, asynchronous=False)
+    ckpt.wait()
+
+
+class LoRAManager:
+    """Device adapter pools + host name/refcount bookkeeping.
+
+    ``max_adapters`` is the number of LOADABLE adapters; the pools hold
+    ``max_adapters + 1`` rows (row 0 = the zero adapter). ``out_features``
+    is the fused-QKV output width ``3 * H * D``.
+    """
+
+    def __init__(self, num_layers: int, hidden_size: int,
+                 out_features: int, *, max_adapters: int, rank: int,
+                 dtype=jnp.float32):
+        if max_adapters < 1:
+            raise ValueError("max_adapters must be >= 1")
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+        self.out_features = int(out_features)
+        self.rank = int(rank)
+        self.max_adapters = int(max_adapters)
+        rows = self.max_adapters + 1
+        self.a = jnp.zeros((num_layers, rows, rank, hidden_size), dtype)
+        self.b = jnp.zeros((num_layers, rows, rank, out_features), dtype)
+        self._rows: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}
+        self._free: List[int] = list(range(1, rows))
+        #: cumulative hot-swaps (loads), mirrored into
+        #: serve_lora_swaps_total under monitor mode
+        self.swaps = 0
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def num_loaded(self) -> int:
+        return len(self._rows)
+
+    def loaded(self) -> List[str]:
+        return sorted(self._rows)
+
+    def row(self, name: str) -> Optional[int]:
+        """Pool row serving ``name``, or None when not loaded."""
+        return self._rows.get(name)
+
+    def refcount(self, name: str) -> int:
+        return self._refs.get(name, 0)
+
+    def pools(self) -> Tuple[object, object]:
+        """The stacked ``(a, b)`` pools — serving-program arguments."""
+        return self.a, self.b
+
+    # -- lifecycle -----------------------------------------------------------
+    def _validate(self, name: str, a, b):
+        L, r = self.num_layers, self.rank
+        want_a = (L, r, self.hidden_size)
+        want_b = (L, r, self.out_features)
+        if tuple(a.shape) != want_a or tuple(b.shape) != want_b:
+            raise ValueError(
+                f"adapter {name!r}: weights are a{tuple(a.shape)} / "
+                f"b{tuple(b.shape)}, this manager serves a{want_a} / "
+                f"b{want_b}")
+        return a, b
+
+    def load_adapter(self, name: str, weights=None,
+                     path: Optional[str] = None) -> int:
+        """Load (hot-swap in) an adapter and return its pool row.
+
+        ``weights``: ``(a [L, r, E], b [L, r, O])`` arrays, or ``path``:
+        a committed checkpoint dir written by
+        :func:`save_adapter_checkpoint`. Everything is verified and
+        shape-checked BEFORE the pools mutate, so a bad source leaves
+        the manager unchanged. Loading an already-loaded name is a no-op
+        (returns its existing row) — swap-in-place requires an explicit
+        unload first, because in-flight requests may reference the row.
+        """
+        if not name:
+            raise ValueError("adapter name must be non-empty")
+        existing = self._rows.get(name)
+        if existing is not None:
+            return existing
+        if (weights is None) == (path is None):
+            raise ValueError("pass exactly one of weights= or path=")
+        if path is not None:
+            from ..distributed import checkpoint as ckpt
+            reason = ckpt.verify_checkpoint(path, level="manifest")
+            if reason is not None:
+                raise ValueError(
+                    f"adapter {name!r}: checkpoint {path} failed "
+                    f"verification: {reason}")
+            state = ckpt.load(path)
+            try:
+                a, b = state["lora_a"], state["lora_b"]
+            except (KeyError, TypeError):
+                raise ValueError(
+                    f"adapter {name!r}: checkpoint {path} holds no "
+                    "lora_a/lora_b entries")
+        else:
+            a, b = weights
+        a = jnp.asarray(a, self.a.dtype)
+        b = jnp.asarray(b, self.b.dtype)
+        self._validate(name, a, b)
+        if not self._free:
+            raise RuntimeError(
+                f"adapter pool full ({self.max_adapters} rows); unload "
+                "an unreferenced adapter first")
+        row = self._free.pop(0)
+        self.a = self.a.at[:, row].set(a)
+        self.b = self.b.at[:, row].set(b)
+        self._rows[name] = row
+        self._refs[name] = 0
+        self.swaps += 1
+        self._publish(swapped=True)
+        return row
+
+    def unload_adapter(self, name: str) -> None:
+        """Refcounted unload: only an adapter no slot references may
+        leave (its row is zeroed and returned to the free list). A
+        referenced adapter raises — the caller retries after the
+        referencing requests drain."""
+        row = self._rows.get(name)
+        if row is None:
+            raise KeyError(f"adapter {name!r} is not loaded")
+        refs = self._refs.get(name, 0)
+        if refs > 0:
+            raise RuntimeError(
+                f"adapter {name!r} still referenced by {refs} slot(s); "
+                "unload only when no slot references the adapter")
+        del self._rows[name]
+        self._refs.pop(name, None)
+        # zero the row so a stale id could only ever select the zero
+        # delta, never another tenant's weights
+        self.a = self.a.at[:, row].set(0.0)
+        self.b = self.b.at[:, row].set(0.0)
+        self._free.append(row)
+        self._publish()
+
+    # -- slot references -----------------------------------------------------
+    def acquire(self, name: str) -> int:
+        """Admission-time reference: the slot now decodes against
+        ``name``. Returns the pool row."""
+        row = self._rows.get(name)
+        if row is None:
+            raise KeyError(f"adapter {name!r} is not loaded")
+        self._refs[name] = self._refs.get(name, 0) + 1
+        return row
+
+    def release(self, name: str) -> None:
+        """Drop a slot's reference (slot freed: finish, preemption,
+        failure, drain)."""
+        refs = self._refs.get(name, 0)
+        if refs <= 0:
+            raise RuntimeError(
+                f"release of adapter {name!r} without a live reference")
+        self._refs[name] = refs - 1
+
+    def rows_for(self, names: Sequence[Optional[str]]):
+        """Per-slot adapter rows for a dispatch: ``None`` (base-model
+        request or empty slot) maps to the zero adapter, row 0."""
+        return jnp.asarray(
+            np.array([0 if n is None else self._rows[n] for n in names],
+                     np.int32))
+
+    def _publish(self, swapped: bool = False) -> None:
+        from ..monitor import enabled as _mon_enabled
+        if not _mon_enabled():
+            return
+        from ..monitor import get_registry
+        reg = get_registry()
+        if swapped:
+            reg.counter(
+                "serve_lora_swaps_total",
+                "LoRA adapter hot-swaps (loads) into the serving "
+                "pools").inc()
+        reg.gauge(
+            "serve_lora_adapters_loaded",
+            "LoRA adapters currently resident in the serving pools "
+            "(zero adapter excluded)").set(float(self.num_loaded))

@@ -30,7 +30,7 @@ from __future__ import annotations
 import itertools
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -79,6 +79,11 @@ class Request:
     ``trace_id`` resumes an existing trace identity under
     ``FLAGS_trace`` (drain snapshots carry it so a request's span tree
     continues on the successor engine); None = the tracer mints one.
+
+    ``tenant`` names the submitting tenant for per-tenant quota +
+    metrics (ISSUE 17; None = untenanted, never quota-limited);
+    ``adapter`` names a loaded LoRA adapter (serving.lora) the request
+    decodes against (None = the base model).
     """
 
     prompt: Sequence[int]
@@ -90,6 +95,8 @@ class Request:
     priority: int = 0
     stop: Optional[Callable] = None
     trace_id: Optional[str] = None
+    tenant: Optional[str] = None
+    adapter: Optional[str] = None
     request_id: int = field(default_factory=lambda: next(_request_ids))
 
     def __post_init__(self):
@@ -272,9 +279,21 @@ class Scheduler:
                  max_queue: int = 1024, clock=time.perf_counter,
                  max_seq_len: Optional[int] = None,
                  policy: str = "reject-new",
-                 on_event: Optional[Callable] = None):
+                 on_event: Optional[Callable] = None,
+                 tenant_quota: Optional[int] = None,
+                 lora=None):
         self.cache = cache
         self.buckets = buckets
+        #: per-tenant fairness (ISSUE 17): max ACTIVE slots any one
+        #: tenant may hold; None disables the check entirely (admission
+        #: is byte-identical to the pre-quota FIFO). Untenanted requests
+        #: are never limited.
+        self.tenant_quota = (int(tenant_quota)
+                             if tenant_quota is not None else None)
+        #: optional serving.lora.LoRAManager: admission acquires the
+        #: request's adapter (slot reference), slot release drops it —
+        #: the refcount unload_adapter checks
+        self.lora = lora
         # the admission limit is the CONFIGURED context window (position
         # embeddings!), not the cache's block-rounded physical capacity
         # which may be up to block_size-1 positions larger
@@ -296,7 +315,10 @@ class Scheduler:
         self.stats = {"submitted": 0, "completed": 0, "preemptions": 0,
                       "admitted": 0, "expired": 0, "expired_queued": 0,
                       "shed": 0, "cancelled": 0, "failed": 0,
-                      "drained": 0}
+                      "drained": 0, "quota_deferred": 0}
+        #: per-tenant quota-deferral counts (cumulative; the engine
+        #: delta-publishes them as a labeled registry counter)
+        self.tenant_deferrals: Dict[str, int] = {}
         # deadline sweeps stay O(0) until the first deadline-carrying
         # request ever arrives
         self._saw_deadline = False
@@ -309,6 +331,7 @@ class Scheduler:
         assert st.outcome is None, \
             f"request {st.request.request_id} already {st.outcome}"
         if st.slot is not None:
+            self._release_adapter(st)
             # prefix-cache donation (ISSUE 15): the K/V this residency
             # computed seeds future prefix hits — except a FAILED
             # request's (a non-finite forward may have written garbage)
@@ -534,13 +557,39 @@ class Scheduler:
         if self.waiting and free_slots and chaos.active() \
                 and chaos.probe("serve.pages.exhaust"):
             return []                  # injected dry pool: admission waits
-        while self.waiting and free_slots:
-            st = self.waiting[0]
+        # idx scans past quota-blocked requests (per-tenant fairness,
+        # ISSUE 17) so one tenant at its cap cannot head-of-line-block
+        # every other tenant; without a quota idx never advances and the
+        # loop is the pre-quota FIFO exactly
+        idx = 0
+        while free_slots and idx < len(self.waiting):
+            st = self.waiting[idx]
             if st.cancel_requested:
                 # a latched in-flight cancel survives preemption back to
                 # the queue: honour it here, never waste a prefill on it
-                self.waiting.pop(0)
+                self.waiting.pop(idx)
                 self._terminate(st, "cancelled")
+                continue
+            if self.tenant_quota is not None \
+                    and st.request.tenant is not None \
+                    and self._tenant_active(st.request.tenant) \
+                    >= self.tenant_quota:
+                self.stats["quota_deferred"] += 1
+                t = st.request.tenant
+                self.tenant_deferrals[t] = \
+                    self.tenant_deferrals.get(t, 0) + 1
+                idx += 1               # skip; later tenants still admit
+                continue
+            if st.request.adapter and (
+                    self.lora is None
+                    or self.lora.row(st.request.adapter) is None):
+                # the adapter was unloaded (or never loaded) between
+                # submit and admission: fail THIS request alone rather
+                # than decode it against the zero adapter silently
+                self.waiting.pop(idx)
+                self._terminate(
+                    st, "failed",
+                    reason=f"adapter {st.request.adapter!r} not loaded")
                 continue
             slot = free_slots[0]
             eff = st.effective_prompt()
@@ -555,13 +604,15 @@ class Scheduler:
             if not self.cache.alloc_slot(slot, eff.size,
                                          shared_pages=shared):
                 break                      # page pool dry: FIFO blocks
-            self.waiting.pop(0)
+            self.waiting.pop(idx)
             free_slots.pop(0)
             st.slot = slot
             st.admitted_t = self.clock()
             st.prefill_pos = n_hit
             st.prefill_len = int(eff.size)
             self.slots[slot] = st
+            if self.lora is not None and st.request.adapter:
+                self.lora.acquire(st.request.adapter)
             admitted.append((slot, st))
             self.stats["admitted"] += 1
         return [st for _, st in admitted]
@@ -618,8 +669,22 @@ class Scheduler:
             return None
         return max(cands, key=lambda s: s.admitted_t)
 
+    def _release_adapter(self, st: RequestState) -> None:
+        """Drop the slot's LoRA adapter reference (acquired at
+        admission) — called from BOTH slot-release paths
+        (:meth:`_terminate`, :meth:`_preempt`), so the reference
+        invariant is exactly "held iff resident"."""
+        if self.lora is not None and st.request.adapter:
+            self.lora.release(st.request.adapter)
+
+    def _tenant_active(self, tenant: str) -> int:
+        """Slots currently held by ``tenant`` (the quota currency)."""
+        return sum(1 for st in self.slots
+                   if st is not None and st.request.tenant == tenant)
+
     def _preempt(self, st: RequestState, count: bool = True) -> None:
         assert st.slot is not None
+        self._release_adapter(st)
         # evicted residencies donate too (vLLM/SGLang recompute policy
         # meets the radix cache): the pages stay warm in the tree, so a
         # re-admission — or any sibling sharing the prefix — hits them

@@ -36,10 +36,11 @@ from paddle_tpu.ops import pallas as pallas_ops
 def test_kernel_registry_enumerates_the_layer():
     rows = {r["kernel"]: r for r in pallas_ops.kernels()}
     assert set(rows) == {"flash_attention", "chunked_ce", "paged_decode",
-                         "int8_matmul"}
+                         "int8_matmul", "bgmv"}
     assert rows["chunked_ce"]["flag"] == "FLAGS_pallas_ce"
     assert rows["paged_decode"]["flag"] == "FLAGS_pallas_paged_decode"
     assert rows["int8_matmul"]["flag"] == "FLAGS_pallas_int8"
+    assert rows["bgmv"]["flag"] == "FLAGS_pallas_bgmv"
     # CPU backend without the interpreter: nothing is live
     assert not any(r["live"] for r in rows.values())
     for r in rows.values():

@@ -28,9 +28,12 @@ from typing import Dict, List, Optional
 DEFAULT_TOLERANCE = 0.10
 # cost-like units: growth is the regression (memory units gate the
 # *_peak_hbm_bytes budget lines the same way time units gate compile/step
-# time)
+# time). bytes/token and bytes/slot are per-unit KV-cache footprints
+# (BENCH_serve, serve_kv_bytes_per_token / serve_kv_bytes_per_slot):
+# growth means the int8 paged-KV compression (FLAGS_serve_kv_quant)
+# regressed toward full precision, so they self-gate like memory.
 _TIME_UNITS = {"ms", "s", "us", "ms/step", "seconds", "bytes", "kib",
-               "mib", "gib"}
+               "mib", "gib", "bytes/token", "bytes/slot"}
 # bounded 0-100 cost rates (growth is the regression) gate on ABSOLUTE
 # percentage points: the healthy baseline is 0, where a relative ratio
 # is undefined and the v_old==0 skip would otherwise make the metric
@@ -60,7 +63,10 @@ _ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%", "accept%"}
 # examples/s (training/serving throughput) and ratio (dedup ratio —
 # mean ids served per row fetched, >= 1) are higher-is-better relative,
 # like tokens/s; listed here so the unit table is exhaustive.
-_RATE_UNIT_EXAMPLES = {"examples/s", "ratio"}
+# "adapters" (BENCH_serve, serve_lora_adapters_per_chip: distinct LoRA
+# adapters servable per chip at the fixed p99 budget) is a capacity
+# count — higher is better, default relative gating, like tokens/s.
+_RATE_UNIT_EXAMPLES = {"examples/s", "ratio", "adapters"}
 
 
 def _metric_list(record) -> List[dict]:

@@ -26,8 +26,11 @@ stream — prints:
   counts (docs/MOE.md; rendered next to the --comms output);
 - with ``--serve``: the serving engine's per-request latency histograms
   (TTFT/TPOT/e2e/decode-step with approximate p50/p99), decode batching
-  occupancy, queue-depth/slot/page gauges and serving program HBM
-  budgets (``serve_*`` series from paddle_tpu.serving; docs/SERVING.md);
+  occupancy, queue-depth/slot/page gauges, serving program HBM
+  budgets, and the multi-tenant view — per-tenant request outcomes and
+  quota deferrals plus the LoRA adapter pool and quantized-KV
+  footprint (``serve_*``/``serve_tenant_*``/``serve_lora_*`` series
+  from paddle_tpu.serving; docs/SERVING.md);
 - with ``--fleet``: the fleet router's per-replica table (queue depth,
   prefix hit%, shed counts) and routing/migration counters + route
   latency (``serve_router_*`` series from paddle_tpu.serving.router;
@@ -542,6 +545,59 @@ def _spec_decode_section(latest, used) -> List[str]:
                   ["stat", "value"], rows)
 
 
+def _tenant_section(latest, used) -> List[str]:
+    """Multi-tenant serving (ISSUE 17): the per-tenant table — requests
+    by lifecycle event from ``serve_tenant_requests_total{tenant,event}``
+    and quota deferrals from
+    ``serve_tenant_quota_deferrals_total{tenant}`` — plus the engine-
+    wide LoRA pool (adapters loaded / hot-swaps) and quantized-KV
+    footprint lines. Runs before the generic serve_* catch-all so the
+    tenant-labeled series render here, once."""
+    per: Dict[str, dict] = {}
+    pool = {}
+    for key, row in latest.items():
+        name, labels = key
+        if name == "serve_tenant_requests_total":
+            used.add(key)
+            lab = dict(labels)
+            d = per.setdefault(lab.get("tenant", "?"), {})
+            d[lab.get("event", "?")] = row.get("value", 0.0)
+        elif name == "serve_tenant_quota_deferrals_total":
+            used.add(key)
+            per.setdefault(dict(labels).get("tenant", "?"),
+                           {})["quota"] = row.get("value", 0.0)
+        elif name in ("serve_lora_swaps_total",
+                      "serve_lora_adapters_loaded",
+                      "serve_kv_quant_bytes_per_token"):
+            used.add(key)
+            pool[name] = row.get("value", 0.0)
+    out: List[str] = []
+    rows = [
+        [t,
+         f"{d.get('submitted', 0):g}",
+         f"{d.get('completed', 0):g}",
+         f"{d.get('failed', 0) + d.get('expired', 0) + d.get('shed', 0):g}",
+         f"{d.get('quota', 0):g}"]
+        for t, d in sorted(per.items())]
+    out += _table("Tenants", ["tenant", "submitted", "completed",
+                              "failed/expired/shed", "quota deferrals"],
+                  rows)
+    if pool:
+        prows = []
+        if "serve_lora_adapters_loaded" in pool:
+            prows.append(["LoRA adapters loaded",
+                          f"{pool['serve_lora_adapters_loaded']:g}"])
+        if "serve_lora_swaps_total" in pool:
+            prows.append(["LoRA adapter hot-swaps",
+                          f"{pool['serve_lora_swaps_total']:g}"])
+        if "serve_kv_quant_bytes_per_token" in pool:
+            prows.append(["quantized KV bytes/token",
+                          f"{pool['serve_kv_quant_bytes_per_token']:g}"])
+        out += _table("Multi-tenant pool (LoRA + quantized KV)",
+                      ["stat", "value"], prows)
+    return out
+
+
 def _overload_timeline(rows: List[dict], used) -> List[str]:
     """Overload-state timeline from EVERY serve_overload sample in the
     (append-only) dump, in file order — each registry dump contributes
@@ -636,6 +692,7 @@ def _serve_section(latest, used, raw_rows: Optional[List[dict]] = None) \
     out += _serve_outcomes(latest, used)
     out += _prefix_cache_section(latest, used)
     out += _spec_decode_section(latest, used)
+    out += _tenant_section(latest, used)
     out += _overload_timeline(raw_rows or [], used)
     occ_rows, g_rows, c_rows, prog_rows = [], [], [], []
     for key in sorted(latest):
