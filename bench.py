@@ -791,7 +791,10 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
     (aggregate vs N x single-replica, weak-scaling points),
     ``serve_fleet_prefix_hit_pct`` (affinity must keep fleet hit%
     within a few points of one engine) and
-    ``serve_router_overhead_p99_ms`` (route-decision latency) — and
+    ``serve_router_overhead_p99_ms`` (route-decision latency) and
+    ``serve_fleet_monitor_overhead_pct`` (ISSUE 18: fleet tokens/s
+    with a 1 Hz FleetFederator attached vs without, absolute points,
+    clamped at 0) — and
     REFUSES to record unless the fleet's greedy outputs are
     token-identical to a single engine's (router parity is an oracle
     pin, same contract as the feature legs above)."""
@@ -871,6 +874,26 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
             router.shutdown()
         return summary, outs
 
+    def federated_phase(n):
+        # the ISSUE 18 fleet plane attached in its production shape:
+        # federator at 1 Hz over the (shared, in-process) registry with
+        # its admin plane bound — measured against the bare fleet run
+        # above; startup/teardown stay outside the measured window
+        from paddle_tpu.monitor.fleet import (FederatorConfig,
+                                              FleetFederator,
+                                              local_registry_target)
+        router = build_fleet(n)
+        fed = FleetFederator([local_registry_target()],
+                             FederatorConfig(interval_s=1.0),
+                             router=router, port=0)
+        fed.start()
+        try:
+            summary = run_fleet_open_loop(router, fleet_spec)
+        finally:
+            fed.close()
+            router.shutdown()
+        return summary
+
     s_one, outs_one = phase(1)
     s_fleet, outs_fleet = phase(n_fleet)
     if outs_fleet != outs_one:
@@ -883,6 +906,13 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
     agg = s_fleet["aggregate_tokens_per_sec"]
     eff = 100.0 * agg / (n_fleet * single_tps)
     p99_ms = s_fleet["route_overhead_p99_s"] * 1e3
+    s_fed = federated_phase(n_fleet)
+    fed_tps = s_fed["aggregate_tokens_per_sec"]
+    monitor_overhead = max(0.0, 100.0 * (agg - fed_tps)
+                           / max(agg, 1e-9))
+    log(f"serve[fleet/{name}]: federator attached at 1 Hz: "
+        f"{fed_tps:.1f} tok/s vs {agg:.1f} bare "
+        f"({monitor_overhead:.1f}% overhead)")
     log(f"serve[fleet/{name}]: {n_fleet} replicas on seed "
         f"{fleet_spec.seed}: aggregate {agg:.1f} tok/s vs single "
         f"{single_tps:.1f} ({eff:.1f}% weak-scaling eff), fleet "
@@ -906,6 +936,11 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
                     vs_baseline=1.0),
         metric_line("serve_fleet_availability_pct",
                     s_fleet["availability_pct"], "%", vs_baseline=1.0),
+        # overhead% gates on ABSOLUTE points in check_bench (healthy
+        # values hover near 0, so a ratio gate would flap on noise)
+        metric_line("serve_fleet_monitor_overhead_pct",
+                    monitor_overhead, "overhead%", vs_baseline=1.0,
+                    federated_tokens_per_sec=round(fed_tps, 1)),
     ]
 
 

@@ -407,6 +407,45 @@ define_flag("monitor_host", "127.0.0.1",
             "the plane exposes flags, program tables and profiles, so "
             "exposing it beyond the host is an explicit operator "
             "decision (front it with real auth if you must).")
+define_flag("fleet_monitor_port", 0,
+            "TCP port for the fleet federator's admin plane "
+            "(paddle_tpu.monitor.fleet): a scrape loop pulls every "
+            "configured replica /metrics page plus the router's "
+            "registry into ONE host-labelled fleet registry and serves "
+            "its own /metrics, /statusz (per-replica table), /healthz "
+            "and /readyz (quorum of replica readiness). -1 = ephemeral "
+            "OS-assigned port. 0 (default) = OFF: no scrape thread, no "
+            "socket, no registry series — the same zero-overhead "
+            "contract as FLAGS_monitor_port, pinned by test.")
+define_flag("fleet_monitor_targets", "",
+            "Comma-separated scrape targets for the fleet federator, "
+            "each 'name=http://host:port' (the /metrics path is "
+            "implied; /readyz and /debug/* derive from the same base). "
+            "Empty (default) = federate the local process registry "
+            "under the single host label 'fleet' — the in-process "
+            "fleet shape, where router and replicas share one "
+            "registry.")
+define_flag("fleet_monitor_interval_s", 1.0,
+            "Fleet federator scrape period in seconds. 1 Hz default — "
+            "windowed fleet rates resolve at scrape granularity, and "
+            "each scrape costs one /metrics page per target (see the "
+            "scrape-interval guidance in docs/OBSERVABILITY.md).")
+define_flag("fleet_monitor_slo", 0.0,
+            "Fleet availability SLO objective as a fraction (e.g. "
+            "0.999). Computed over the FEDERATED serve_requests_total "
+            "deltas (good=completed; bad=expired/failed/shed) via the "
+            "PR 11 SLOTracker; burn gauges publish as "
+            "slo_burn_rate{slo='fleet_availability'}. 0 (default) = "
+            "no fleet SLO tracker.")
+define_flag("fleet_monitor_incident_dir", "",
+            "Directory for anomaly-triggered incident bundles: when a "
+            "fleet SLO burn alert fires or a tail-retained anomaly "
+            "trace lands, the federator captures the implicated "
+            "replica's flight-recorder doc, the merged Perfetto trace, "
+            "the fleet statusz snapshot and the federated metrics page "
+            "into a timestamped incident_* subdir (rate-limited; "
+            "bundle dirs are .gitignore'd). Empty (default) = no "
+            "incident capture.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -57,6 +57,17 @@ Live telemetry plane (ISSUE 14 — the pull-while-running half):
     (``tools/monitor_top.py``), plus **multi-host aggregation**
     (``MetricsRegistry.merge`` / ``tools/aggregate_metrics.py``).
 
+Fleet observability plane (ISSUE 18 — one pane for many processes):
+
+12. the **fleet federator** (:mod:`.fleet`, ``FLAGS_fleet_monitor_*``):
+    a scrape loop federating every replica's ``/metrics`` page (plus
+    the router's registry) into ONE host-labelled fleet registry with
+    its own admin plane, cross-process trace merging
+    (:func:`~.fleet.merge_fleet_traces` joins the router's
+    ``fleet.request`` tree with each replica's ``serve.request`` tree
+    under one trace_id), fleet SLO burn over the federated counters,
+    and anomaly-triggered, rate-limited incident bundles.
+
 The registry is always importable and writable; the HOT paths only write
 to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
 the write_count guard in tests/test_monitor.py; the flight recorder has
@@ -64,7 +75,8 @@ the same contract via ``FLAGS_flight_recorder`` and its
 ``record_count`` probe).
 """
 
-from . import flight_recorder, memory, slo, timeseries, trace  # noqa: F401
+from . import (fleet, flight_recorder, memory, slo,  # noqa: F401
+               timeseries, trace)
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               get_flight_recorder, set_flight_recorder)
 from .memory import (LeakMonitor, MemoryBudgetError,  # noqa: F401
@@ -79,6 +91,7 @@ from .numerics import (NaNWatchdog, NonFiniteError, all_finite,  # noqa: F401
 from .slo import SLOTracker  # noqa: F401
 from .trace import (Span, Trace, Tracer, export_perfetto,  # noqa: F401
                     get_tracer, set_tracer, start_trace)
+from .fleet import FleetFederator, merge_fleet_traces  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -92,6 +105,7 @@ __all__ = [
     "enabled",
     "Span", "Trace", "Tracer", "get_tracer", "set_tracer",
     "start_trace", "export_perfetto", "SLOTracker",
+    "FleetFederator", "merge_fleet_traces",
 ]
 
 

@@ -15,10 +15,14 @@ redraw by ``tools/monitor_top.py``), and answers:
 - :meth:`delta` — plain Δvalue over the window;
 - :meth:`latest` / :meth:`series` — current value / the raw points.
 
-Histograms flatten into two value series — ``<name>_count`` and
-``<name>_sum`` — matching their Prometheus sample names, so
-``rate("serve_e2e_seconds_count")`` is completions/s and
-``delta(sum)/delta(count)`` is the windowed mean latency.
+Histograms flatten into their Prometheus sample names: ``<name>_count``
+and ``<name>_sum`` plus one cumulative ``<name>_bucket`` series per
+``le`` bound (ISSUE 18), so ``rate("serve_e2e_seconds_count")`` is
+completions/s, ``delta(sum)/delta(count)`` is the windowed mean
+latency, and :meth:`quantile` interpolates a WINDOWED p50/p99 off the
+bucket deltas (counter-reset folding applies to bucket series exactly
+as to any counter — a restarted replica's scrape cannot yield negative
+bucket mass).
 
 Everything is host-side floats under one lock; a ring of 256 snapshots
 of a few hundred series is ~100 KiB. Nothing here touches the registry
@@ -27,14 +31,16 @@ monitor-off path is untouched.
 
 :func:`parse_prometheus` is the inverse of
 ``MetricsRegistry.to_prometheus`` for the subset the ring needs
-(counter/gauge samples + histogram ``_count``/``_sum`` lines) — it lets
-``tools/monitor_top.py`` feed a ring from a scraped ``/metrics`` page
-of ANY process, not just this one.
+(counter/gauge samples + histogram ``_count``/``_sum``/``_bucket``
+lines) — it lets ``tools/monitor_top.py`` and the fleet federator feed
+a ring from a scraped ``/metrics`` page of ANY process, not just this
+one.
 """
 
 from __future__ import annotations
 
 import collections
+import math
 import re
 import threading
 import time
@@ -78,6 +84,16 @@ class TimeseriesRing:
                                  float(value["count"])))
                     rows.append((f"{name}_sum", labels, "counter",
                                  float(value["sum"])))
+                    # per-bucket cumulative series on the exposition's
+                    # exact `le` grid — the windowed bucket deltas
+                    # `quantile` interpolates over
+                    for le, cum in value["buckets"]:
+                        rows.append((f"{name}_bucket",
+                                     dict(labels, le=repr(float(le))),
+                                     "counter", float(cum)))
+                    rows.append((f"{name}_bucket",
+                                 dict(labels, le="+Inf"),
+                                 "counter", float(value["count"])))
                 else:
                     rows.append((name, labels, kind, float(value)))
         return self._ingest(rows, now)
@@ -169,15 +185,63 @@ class TimeseriesRing:
         d = self.delta(name, window_s, **labels)
         return None if d is None else d / span
 
+    def quantile(self, name: str, q: float,
+                 window_s: Optional[float] = None,
+                 **labels) -> Optional[float]:
+        """WINDOWED quantile interpolated from ``<name>_bucket`` deltas
+        (Prometheus ``histogram_quantile`` semantics: linear inside the
+        winning bucket, the last finite bound when q lands in +Inf).
+        Counter resets fold out per bucket series, so a restarted
+        writer shrinks the window's mass instead of corrupting it.
+        None when no bucket series match or the window saw no
+        observations — a quantile over nothing is not 0.0."""
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        bname = f"{name}_bucket"
+        want = {k: str(v) for k, v in labels.items()}
+        grid: List[Tuple[float, float]] = []
+        for ls in self.label_sets(bname):
+            le = ls.get("le")
+            if le is None:
+                continue
+            if {k: v for k, v in ls.items() if k != "le"} != want:
+                continue
+            d = self.delta(bname, window_s, **ls)
+            if d is None:
+                continue
+            grid.append((math.inf if le == "+Inf" else float(le), d))
+        if not grid:
+            return None
+        grid.sort()
+        total = grid[-1][1]
+        if total <= 0:
+            return None
+        target = q * total
+        prev_b, prev_c = 0.0, 0.0
+        for bound, cum in grid:
+            if cum >= target:
+                if math.isinf(bound):
+                    return prev_b  # last finite bound, like Prometheus
+                if cum <= prev_c:
+                    return bound
+                lo = prev_b if prev_c > 0 or bound <= 0 else 0.0
+                return lo + (bound - lo) * (target - prev_c) \
+                    / (cum - prev_c)
+            prev_b, prev_c = bound, cum
+        return prev_b
+
     def rates(self, window_s: Optional[float] = None) -> Dict[str, float]:
         """{``name{label=v,...}``: per-second rate} for every COUNTER
-        series with enough history — the ``/statusz`` movement view."""
+        series with enough history — the ``/statusz`` movement view.
+        Histogram ``_bucket`` series are left out (a 16-bound grid per
+        histogram would drown the page; read them via
+        :meth:`quantile`)."""
         with self._lock:
             keys = list(self._series)
             kinds = dict(self._kinds)
         out: Dict[str, float] = {}
         for name, labels in keys:
-            if kinds.get(name) != "counter":
+            if kinds.get(name) != "counter" or name.endswith("_bucket"):
                 continue
             r = self.rate(name, window_s, **dict(labels))
             if r is None:
@@ -224,9 +288,11 @@ def _unescape(v: str) -> str:
 def parse_prometheus(text: str) -> List[dict]:
     """Parse a text exposition page into rows shaped like
     ``load_jsonl`` output: ``{name, type, labels, value}``. Histogram
-    ``_bucket`` lines are skipped (the ring wants ``_count``/``_sum``);
-    exemplar suffixes are ignored; unparseable lines are skipped (a
-    scrape of a foreign process must degrade, not crash)."""
+    samples come back as their flattened ``_count``/``_sum``/``_bucket``
+    counter rows (ISSUE 18 — the fleet federator and :meth:`quantile`
+    need the bucket grid); exemplar suffixes are ignored; unparseable
+    lines are skipped (a scrape of a foreign process must degrade, not
+    crash)."""
     rows: List[dict] = []
     kinds: Dict[str, str] = {}
     for line in text.splitlines():
@@ -242,13 +308,11 @@ def parse_prometheus(text: str) -> List[dict]:
         if m is None:
             continue
         name, labelstr, value = m.group(1), m.group(2), m.group(3)
-        if name.endswith("_bucket"):
-            continue
         labels = {k: _unescape(v)
                   for k, v in _LABEL_RE.findall(labelstr or "")}
         kind = kinds.get(name)
         if kind is None:
-            for suffix in ("_count", "_sum"):
+            for suffix in ("_bucket", "_count", "_sum"):
                 if name.endswith(suffix) and \
                         kinds.get(name[:-len(suffix)]) == "histogram":
                     kind = "counter"

@@ -82,6 +82,18 @@ def _new_trace_id() -> str:
     return f"{os.getpid():x}-{next(_trace_seq):04x}"
 
 
+_ctx_seq = itertools.count(1)
+
+
+def _new_ctx() -> str:
+    """Per-Trace context handle, unique ACROSS processes (pid-scoped) —
+    the namespace cross-process span references live in. Two processes
+    (or two engines in one process) can buffer the same ``trace_id``
+    concurrently; their ctx handles never collide, so the fleet merge
+    (monitor/fleet.py) can join their spans without id clashes."""
+    return f"{os.getpid():x}.{next(_ctx_seq):x}"
+
+
 class Span:
     """One timed unit of work inside a trace. ``t1`` is None while
     open; ``attrs`` are free-form JSON-safe values."""
@@ -137,11 +149,23 @@ class Trace:
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  head_sampled: bool, t0: float,
-                 attrs: Dict[str, Any]):
+                 attrs: Dict[str, Any],
+                 process: Optional[str] = None,
+                 parent: Optional[str] = None):
         self._tracer = tracer
         self.name = name
         self.trace_id = trace_id
         self.head_sampled = head_sampled
+        #: unique buffer handle (see :func:`_new_ctx`) — the namespace
+        #: qualifying this trace's span ids in cross-process references
+        self.ctx = _new_ctx()
+        #: which process/replica produced this span tree (one Perfetto
+        #: track per distinct process in the merged fleet doc)
+        self.process = process
+        #: ``"<ctx>/<span_id>"`` of the span (in ANOTHER trace buffer,
+        #: usually another process) this tree's root parents under —
+        #: the Dapper join the fleet merge resolves
+        self.parent_ctx = parent
         #: first anomaly reason seen (None = healthy so far)
         self.anomaly: Optional[str] = None
         self.finished = False
@@ -184,6 +208,14 @@ class Trace:
         sp.t1 = sp.t0
         return sp
 
+    def context_for(self, span: Optional[Span] = None) -> str:
+        """The propagation token for ``span`` (default: the root):
+        ``"<ctx>/<span_id>"``, globally unambiguous. A downstream
+        process opens its trace with ``parent=<token>`` (same
+        ``trace_id``) and the fleet merge parents its root there."""
+        sp = span if span is not None else self.root
+        return f"{self.ctx}/{sp.span_id}"
+
     def mark_anomaly(self, reason: str, **attrs) -> None:
         """Flag the trace for tail-retention. The FIRST reason sticks
         (it is the one that made the trace weird); later marks only add
@@ -197,10 +229,16 @@ class Trace:
     def to_dict(self) -> dict:
         with self._tracer._lock:
             spans = [s.to_dict() for s in self.spans]
-        return {"trace_id": self.trace_id, "name": self.name,
-                "head_sampled": self.head_sampled,
-                "anomaly": self.anomaly, "finished": self.finished,
-                "spans": spans}
+        d = {"trace_id": self.trace_id, "name": self.name,
+             "ctx": self.ctx,
+             "head_sampled": self.head_sampled,
+             "anomaly": self.anomaly, "finished": self.finished,
+             "spans": spans}
+        if self.process is not None:
+            d["process"] = self.process
+        if self.parent_ctx is not None:
+            d["parent_ctx"] = self.parent_ctx
+        return d
 
 
 class Tracer:
@@ -232,19 +270,28 @@ class Tracer:
     # -- lifecycle ----------------------------------------------------------
     def start_trace(self, name: str, trace_id: Optional[str] = None,
                     sample: Optional[bool] = None, t: Optional[float]
-                    = None, **attrs) -> Trace:
+                    = None, process: Optional[str] = None,
+                    parent: Optional[str] = None, **attrs) -> Trace:
         """Open a trace. ``trace_id`` resumes an identity (drain/resume
-        hands the id across engines); ``sample`` overrides the head
-        coin (tests, resumed traces that were already being kept)."""
+        and the fleet router hand the id across engines); ``sample``
+        overrides the head coin (tests, resumed traces that were
+        already being kept); ``process`` labels the producing
+        process/replica (one Perfetto track per process in the merged
+        fleet doc); ``parent`` is a :meth:`Trace.context_for` token the
+        new tree's root parents under — the cross-process Dapper link.
+        The live table keys on the per-Trace ``ctx`` handle, so a
+        router trace and an in-process replica trace may buffer the
+        SAME trace_id concurrently without evicting each other."""
         if sample is None:
             rate = self._sample_rate()
             sample = (rate >= 1.0
                       or (rate > 0.0 and self._rng.random() < rate))
         tr = Trace(self, name,
                    trace_id if trace_id else _new_trace_id(),
-                   bool(sample), self.clock() if t is None else t, attrs)
+                   bool(sample), self.clock() if t is None else t,
+                   attrs, process=process, parent=parent)
         with self._lock:
-            self._live[tr.trace_id] = tr
+            self._live[tr.ctx] = tr
             TRACE_STATS["traces_started"] += 1
         return tr
 
@@ -257,7 +304,7 @@ class Tracer:
             if trace.finished:
                 return trace in self._retained
             trace.finished = True
-            self._live.pop(trace.trace_id, None)
+            self._live.pop(trace.ctx, None)
             trace.end_span(trace.root, t=t)
             keep = trace.head_sampled or trace.anomaly is not None
             if keep:
@@ -427,23 +474,55 @@ def perfetto_doc(traces: Optional[List[dict]] = None,
                  include_host_timeline: bool = True) -> dict:
     """The merged Perfetto/chrome-trace document as a dict — what
     :func:`export_perfetto` writes. Factored out so the admin server's
-    ``/debug/trace?format=perfetto`` serves it straight from memory."""
+    ``/debug/trace?format=perfetto`` serves it straight from memory.
+
+    Track model (ISSUE 18): one Perfetto *process* (pid) per distinct
+    producing process label — a trace doc's ``process`` field, or a
+    per-span ``process`` key in a fleet-merged doc — and inside each
+    process ONE track (tid) per ``trace_id``. Docs without a process
+    label all land on the classic ``paddle_tpu.trace`` pid, and
+    distinct trace_ids get distinct tids, so single-process exports
+    render exactly as before; a merged fleet trace renders as the
+    router process plus one process per replica, each showing its own
+    slice of the same request side by side."""
     if traces is None:
         traces = get_tracer().snapshot(include_live=True)
     events: List[dict] = []
     meta: List[dict] = []
-    meta.append({"ph": "M", "name": "process_name", "pid": 1,
-                 "args": {"name": "paddle_tpu.trace"}})
-    for tid, tdoc in enumerate(traces, start=1):
-        label = f"{tdoc.get('name', 'trace')} {tdoc.get('trace_id', '')}"
-        if tdoc.get("anomaly"):
-            label += f" [ANOMALY:{tdoc['anomaly']}]"
-        meta.append({"ph": "M", "name": "thread_name", "pid": 1,
-                     "tid": tid, "args": {"name": label}})
+    pids: Dict[Optional[str], int] = {}
+    tids: Dict[tuple, int] = {}
+
+    def _pid(proc: Optional[str]) -> int:
+        pid = pids.get(proc)
+        if pid is None:
+            pid = pids[proc] = len(pids) + 1
+            label = ("paddle_tpu.trace" if proc is None
+                     else f"paddle_tpu.trace:{proc}")
+            meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                         "args": {"name": label}})
+        return pid
+
+    def _tid(pid: int, tdoc: dict) -> int:
+        key = (pid, tdoc.get("trace_id"))
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = 1 + sum(1 for p, _ in tids if p == pid)
+            label = (f"{tdoc.get('name', 'trace')} "
+                     f"{tdoc.get('trace_id', '')}")
+            if tdoc.get("anomaly"):
+                label += f" [ANOMALY:{tdoc['anomaly']}]"
+            meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                         "tid": tid, "args": {"name": label}})
+        return tid
+
+    for tdoc in traces:
+        doc_proc = tdoc.get("process")
         for s in tdoc.get("spans") or []:
             t0 = s.get("t0")
             if t0 is None:
                 continue
+            pid = _pid(s.get("process", doc_proc))
+            tid = _tid(pid, tdoc)
             t1 = s.get("t1")
             dur = 0.0 if t1 is None else max(0.0, float(t1) - float(t0))
             args = dict(s.get("attrs") or {})
@@ -453,7 +532,7 @@ def perfetto_doc(traces: Optional[List[dict]] = None,
                 args["parent_id"] = s.get("parent_id")
             events.append({"name": s.get("name", "?"), "ph": "X",
                            "ts": float(t0) * 1e6, "dur": dur * 1e6,
-                           "pid": 1, "tid": tid, "cat": "trace",
+                           "pid": pid, "tid": tid, "cat": "trace",
                            "args": args})
     if include_host_timeline:
         try:

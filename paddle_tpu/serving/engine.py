@@ -872,16 +872,31 @@ class ServingEngine:
             # retention needs the buffer regardless of the head coin,
             # so the trace exists for every request while the flag is
             # on — the flag OFF path allocates nothing (pinned).
-            resumed = request.trace_id is not None
+            # a bare trace_id is a drain/resume identity handover; one
+            # arriving WITH a parent token is just downstream context
+            # from the fleet router — not a resume
+            resumed = (request.trace_id is not None
+                       and request.trace_parent is None)
             tr = _trace.get_tracer().start_trace(
                 "serve.request", trace_id=request.trace_id,
-                # a resumed identity was handed over deliberately (its
-                # first half may already be retained) — never let a
-                # re-flip of the head coin drop the continuation. All
-                # spans run on the ENGINE clock (t=): injectable in
-                # tests, one time domain per trace.
-                sample=True if resumed else None,
+                # the upstream (router) head decision wins when the
+                # context carries one — Dapper's sampled bit, ONE coin
+                # per distributed trace. Otherwise a resumed identity
+                # was handed over deliberately (its first half may
+                # already be retained) — never let a re-flip of the
+                # head coin drop the continuation. All spans run on the
+                # ENGINE clock (t=): injectable in tests, one time
+                # domain per trace.
+                sample=(request.trace_sampled
+                        if request.trace_sampled is not None
+                        else (True if resumed else None)),
                 t=st.submitted_t,
+                # cross-process parent link + producing-replica label
+                # (ISSUE 18): the fleet merge parents this tree under
+                # the router's route/hop span and renders it on this
+                # replica's own Perfetto track
+                process=request.trace_process,
+                parent=request.trace_parent,
                 request_id=request.request_id,
                 prompt_len=st.prompt_len,
                 max_new_tokens=request.max_new_tokens,

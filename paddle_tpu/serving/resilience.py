@@ -314,12 +314,20 @@ def request_spec(st) -> dict:
     }
     # trace-context survival: the successor engine resumes the SAME
     # trace_id (monitor/trace.py), so a drained request's span tree
-    # continues instead of forking a new identity
+    # continues instead of forking a new identity. The PARENT link and
+    # process label survive too (ISSUE 18): a continuation restored
+    # outside the router still parents under the original router span
+    # in the merged fleet trace (a router-driven resubmit overrides
+    # both with a fresh migration-hop span).
     tr = getattr(st, "trace", None)
     trace_id = (tr.trace_id if tr is not None
                 else getattr(req, "trace_id", None))
     if trace_id is not None:
         spec["trace_id"] = str(trace_id)
+    for k in ("trace_parent", "trace_process"):
+        v = getattr(req, k, None)
+        if v is not None:
+            spec[k] = str(v)
     return spec
 
 
@@ -403,5 +411,7 @@ def requests_from_snapshot(specs: List[dict]) -> List[object]:
             sampling=SamplingParams(**(d.get("sampling") or {})),
             eos_token_id=d.get("eos_token_id"),
             priority=int(d.get("priority", 0)),
-            trace_id=d.get("trace_id")))
+            trace_id=d.get("trace_id"),
+            trace_parent=d.get("trace_parent"),
+            trace_process=d.get("trace_process")))
     return out

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..monitor import get_registry
+from ..monitor import trace as _trace
 from ..monitor.flight_recorder import safe_record_event
 from .resilience import (ServerOverloaded, load_drain_snapshot,
                          requests_from_snapshot)
@@ -145,6 +146,8 @@ class _RouterRecord:
     replica: str
     tokens: List[int] = field(default_factory=list)   # streamed so far
     trace_id: Optional[str] = None
+    trace: object = None                # live fleet.request Trace, if any
+    trace_parent: Optional[str] = None  # last propagated parent token
     hops: int = 0                       # migrations survived
     done: bool = False
     outcome: Optional[str] = None
@@ -200,6 +203,13 @@ class FleetRouter:
         self._threads: List[threading.Thread] = []
         self._stop_evt = threading.Event()
         self._tmp_drain_dir: Optional[str] = None
+        # fleet observability plane (ISSUE 18): ONE flag read when off;
+        # when on, attach this router so the federated /statusz table
+        # carries the authoritative per-replica view
+        from ..monitor import fleet as _fleet
+        fed = _fleet.maybe_start_from_flags()
+        if fed is not None and fed.router is None:
+            fed.router = self
 
     # -- placement ----------------------------------------------------------
     @staticmethod
@@ -245,15 +255,25 @@ class FleetRouter:
                 return rep
         return None
 
-    def _route(self, prompt) -> Optional[ReplicaHandle]:
+    def _route(self, prompt,
+               info: Optional[dict] = None) -> Optional[ReplicaHandle]:
         affine = self._affine_replica(prompt)
+        if info is not None:       # tracing-only route-decision detail
+            info["affinity_key"] = \
+                f"{self._hash(self._affinity_key(prompt)):016x}"
         if affine is not None and not self._saturated(affine):
             self._stats["routed_affine"] += 1
             get_registry().counter(
                 "serve_router_requests_total",
                 "requests placed by the fleet router, by route "
                 "kind").inc(route="affine")
+            if info is not None:
+                info["route"] = "affine"
             return affine
+        if info is not None:
+            info["route"] = "balanced"
+            if affine is not None:
+                info["fallback"] = "saturation"
         ready = [r for r in self.replicas.values() if self._ready(r)]
         if not ready:
             return None
@@ -289,15 +309,40 @@ class FleetRouter:
         will take it (counted — availability accounting includes
         refusals)."""
         t0 = self.clock()
-        rep = self._route(request.prompt)
+        tr = route_sp = info = None
+        if _trace.enabled():
+            # ONE distributed trace per fleet request: the router owns
+            # the root ("fleet.request"); the replica's serve.request
+            # tree parents under the route (or migration-hop) span via
+            # the context carried on the Request. Flags off ⇒ this
+            # whole branch is a single boolean read and the fast path
+            # stays allocation-free (pinned by test).
+            tr = _trace.get_tracer().start_trace(
+                "fleet.request", trace_id=request.trace_id, t=t0,
+                process="router",
+                request_id=int(request.request_id),
+                tenant=request.tenant)
+            route_sp = tr.start_span("route", t=t0)
+            info = {}
+        rep = self._route(request.prompt, info)
         dt = self.clock() - t0
         self._route_lat.append(dt)
         get_registry().histogram(
             "serve_router_route_seconds",
             "fleet route-decision wall time").observe(dt)
         if rep is None:
+            if tr is not None:
+                tr.end_span(route_sp, t=t0 + dt, **info)
+                tr.mark_anomaly("shed", reject="no ready replica")
+                _trace.get_tracer().finish_trace(tr)
             self._reject()
             raise ServerOverloaded("no ready replica")
+        if tr is not None:
+            tr.end_span(route_sp, t=t0 + dt, replica=rep.name, **info)
+            request.trace_id = tr.trace_id
+            request.trace_parent = tr.context_for(route_sp)
+            request.trace_process = rep.name
+            request.trace_sampled = tr.head_sampled
         rec = _RouterRecord(
             request_id=int(request.request_id),
             prompt=[int(t) for t in
@@ -308,7 +353,8 @@ class FleetRouter:
             priority=int(request.priority),
             client_on_token=request.on_token,
             client_stop=request.stop,
-            replica=rep.name)
+            replica=rep.name,
+            trace=tr, trace_parent=request.trace_parent)
         request.on_token = self._tee(rec)
         try:
             st = rep.submit(request)
@@ -321,18 +367,27 @@ class FleetRouter:
                      if r is not rep and self._ready(r)),
                     key=self._load):
                 try:
+                    if tr is not None:
+                        request.trace_process = other.name
                     st = other.submit(request)
+                    if tr is not None:
+                        tr.event("overflow", from_replica=rep.name,
+                                 to_replica=other.name)
                     rep = other
                     break
                 except ServerOverloaded:
                     continue
             else:
+                if tr is not None:
+                    tr.mark_anomaly("shed",
+                                    reject="all replicas overloaded")
+                    _trace.get_tracer().finish_trace(tr)
                 self._reject()
                 raise
         rec.replica = rep.name
         rec.state = st
-        tr = getattr(st, "trace", None)
-        rec.trace_id = (tr.trace_id if tr is not None
+        st_tr = getattr(st, "trace", None)
+        rec.trace_id = (st_tr.trace_id if st_tr is not None
                         else request.trace_id)
         with self._lock:
             self._records[rec.request_id] = rec
@@ -365,24 +420,47 @@ class FleetRouter:
         streaming continues into the same record, trace identity
         survives."""
         rec.state = None
+        tr = rec.trace
+        hop = (tr.start_span("migrate", reason=reason,
+                             from_replica=rec.replica,
+                             hop=rec.hops + 1,
+                             tokens_streamed=len(rec.tokens))
+               if tr is not None else None)
         target = self._affine_replica(rec.prompt)
         if target is None or self._saturated(target):
             picked = self._route(rec.prompt)
             target = picked if picked is not None else target
         if target is None:
+            if hop is not None:
+                tr.end_span(hop, outcome="failed",
+                            reject="no ready replica")
             rec.done = True
             rec.outcome = "failed"
             self._stats["migration_failed"] += 1
             return False
         request.on_token = self._tee(rec)
         request.stop = rec.client_stop
+        if hop is not None:
+            # each hop re-parents the continuation: the survivor's
+            # serve.request tree hangs off THIS migration span
+            request.trace_id = tr.trace_id
+            request.trace_parent = tr.context_for(hop)
+            request.trace_process = target.name
+            request.trace_sampled = tr.head_sampled
+            rec.trace_parent = request.trace_parent
         try:
             st = target.submit(request)
         except ServerOverloaded:
+            if hop is not None:
+                tr.end_span(hop, outcome="failed",
+                            to_replica=target.name,
+                            reject="target overloaded")
             rec.done = True
             rec.outcome = "failed"
             self._stats["migration_failed"] += 1
             return False
+        if hop is not None:
+            tr.end_span(hop, to_replica=target.name)
         rec.replica = target.name
         rec.state = st
         rec.hops += 1
@@ -473,6 +551,11 @@ class FleetRouter:
                 }
                 if rec.trace_id is not None:
                     spec["trace_id"] = rec.trace_id
+                if rec.trace_parent is not None:
+                    # keep the fleet parent link across the journal
+                    # round-trip (the drain path carries it inside the
+                    # engine snapshot already)
+                    spec["trace_parent"] = rec.trace_parent
                 reqs = requests_from_snapshot([spec])
                 if not reqs:
                     # budget exhausted before the death: completed
@@ -486,17 +569,25 @@ class FleetRouter:
 
     # -- driving ------------------------------------------------------------
     def _sweep(self) -> None:
-        """Fold engine-side completions into the router's records and
-        refresh the fleet gauges."""
+        """Fold engine-side completions into the router's records,
+        close each finished record's fleet trace (terminal failures
+        tail-retain it), and refresh the fleet gauges."""
         with self._lock:
             for rec in self._records.values():
                 st = rec.state
-                if rec.done or st is None:
-                    continue
-                if st.outcome in _TERMINAL_OUTCOMES:
+                if not rec.done and st is not None \
+                        and st.outcome in _TERMINAL_OUTCOMES:
                     rec.done = True
                     rec.outcome = st.outcome
                     rec.state = None
+                if rec.done and rec.trace is not None:
+                    tr = rec.trace
+                    rec.trace = None
+                    tr.root.attrs.update(outcome=rec.outcome,
+                                         hops=rec.hops)
+                    if rec.outcome in _trace.ANOMALY_REASONS:
+                        tr.mark_anomaly(rec.outcome)
+                    _trace.get_tracer().finish_trace(tr)
 
     def step_all(self) -> bool:
         """One synchronous round-robin pass over the live replicas.
@@ -687,6 +778,15 @@ class FleetRouter:
     def shutdown(self) -> None:
         """Stop threads and shut every live replica down."""
         self.stop()
+        self._sweep()
+        with self._lock:
+            for rec in self._records.values():
+                # close dangling fleet traces so the tracer's live map
+                # never leaks (finish_trace is idempotent)
+                if rec.trace is not None:
+                    tr = rec.trace
+                    rec.trace = None
+                    _trace.get_tracer().finish_trace(tr)
         for rep in self.replicas.values():
             if rep.alive:
                 rep.alive = False

@@ -79,6 +79,14 @@ class Request:
     ``trace_id`` resumes an existing trace identity under
     ``FLAGS_trace`` (drain snapshots carry it so a request's span tree
     continues on the successor engine); None = the tracer mints one.
+    ``trace_parent`` / ``trace_process`` / ``trace_sampled`` are the
+    rest of the cross-process trace context (ISSUE 18): the
+    ``Trace.context_for`` token of the upstream (router) span this
+    request's ``serve.request`` tree parents under, the replica label
+    the submitter assigned this engine (one Perfetto track per
+    process), and the upstream head-sampling decision — Dapper's
+    sampled bit, so one coin governs every process's slice of the
+    trace. All None for a bare single-engine submit.
 
     ``tenant`` names the submitting tenant for per-tenant quota +
     metrics (ISSUE 17; None = untenanted, never quota-limited);
@@ -95,6 +103,9 @@ class Request:
     priority: int = 0
     stop: Optional[Callable] = None
     trace_id: Optional[str] = None
+    trace_parent: Optional[str] = None
+    trace_process: Optional[str] = None
+    trace_sampled: Optional[bool] = None
     tenant: Optional[str] = None
     adapter: Optional[str] = None
     request_id: int = field(default_factory=lambda: next(_request_ids))

@@ -156,6 +156,18 @@ def _admin_server_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _fleet_monitor_isolation():
+    """The fleet federator (monitor/fleet.py) must not leak its scrape
+    thread, admin socket or registry between tests. Only touches the
+    module when a test imported it."""
+    import sys
+    yield
+    mod = sys.modules.get("paddle_tpu.monitor.fleet")
+    if mod is not None:
+        mod.stop_federator()
+
+
+@pytest.fixture(autouse=True)
 def _trace_isolation():
     """Structured-tracer state (retained ring, live traces, allocation
     probe) must not leak between tests — the zero-overhead pin reads

@@ -1,0 +1,473 @@
+"""Fleet observability plane (ISSUE 18): cross-process trace merging,
+the metrics federator + its admin plane, SLO-fed incident capture with
+rate limiting, windowed histogram quantiles on the timeseries ring, and
+the zero-overhead contract (docs/OBSERVABILITY.md "Fleet
+observability")."""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.core.flags import flag_scope
+from paddle_tpu.monitor import trace as trace_mod
+from paddle_tpu.monitor.fleet import (SCRAPE_THREAD_PREFIX,
+                                      FederatorConfig, FleetFederator,
+                                      FleetTarget, get_federator,
+                                      local_registry_target,
+                                      maybe_start_from_flags,
+                                      merge_fleet_traces, parse_targets)
+from paddle_tpu.monitor.metrics import MetricsRegistry, lint_exposition
+from paddle_tpu.monitor.timeseries import (TimeseriesRing,
+                                           parse_prometheus)
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# timeseries ring: bucket series + windowed quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_ring_snapshots_bucket_series_and_quantile():
+    clock = ManualClock()
+    reg = MetricsRegistry()
+    ring = TimeseriesRing(clock=clock)
+    h = reg.histogram("lat_seconds", "x", buckets=(0.1, 0.5, 1.0))
+    h.observe(0.05)
+    ring.snapshot(reg)
+    clock.advance(10.0)
+    for _ in range(20):
+        h.observe(0.3)
+    ring.snapshot(reg)
+    # the bucket grid became per-le counter series
+    assert ring.kind("lat_seconds_bucket") == "counter"
+    assert ring.latest("lat_seconds_bucket", le="+Inf") == 21.0
+    # windowed quantile: all 20 in-window observations sit in (0.1, .5]
+    q50 = ring.quantile("lat_seconds", 0.5)
+    assert q50 is not None and 0.0 < q50 <= 0.5
+    assert ring.quantile("lat_seconds", 1.0) == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        ring.quantile("lat_seconds", 1.5)
+    # no matching bucket series -> None, not 0.0
+    assert ring.quantile("nope", 0.5) is None
+
+
+def test_ring_quantile_folds_counter_resets():
+    """A restarted writer (bucket counters drop) must shrink the
+    window's mass, never go negative or corrupt the interpolation."""
+    clock = ManualClock()
+    ring = TimeseriesRing(clock=clock)
+
+    def rows(n_count, le_counts):
+        out = [{"name": "lat_seconds_bucket", "type": "counter",
+                "labels": {"le": le}, "value": float(v)}
+               for le, v in le_counts]
+        out.append({"name": "lat_seconds_bucket", "type": "counter",
+                    "labels": {"le": "+Inf"}, "value": float(n_count)})
+        return out
+
+    ring.ingest_rows(rows(100, [("0.1", 100.0)]))
+    clock.advance(1.0)
+    # restart: counters fall back to near zero, then 4 obs in (0.1, 1]
+    ring.ingest_rows(rows(0, [("0.1", 0.0)]))
+    clock.advance(1.0)
+    ring.ingest_rows(rows(4, [("0.1", 0.0), ("1.0", 4.0)]))
+    q = ring.quantile("lat_seconds", 0.5)
+    assert q is not None and 0.0 < q <= 1.0
+
+
+def test_parse_prometheus_types_histogram_suffixes():
+    reg = MetricsRegistry()
+    reg.histogram("h_seconds", "x", buckets=(0.5,)).observe(0.2)
+    rows = parse_prometheus(reg.to_prometheus())
+    by = {(r["name"], r["labels"].get("le")): r for r in rows}
+    assert by[("h_seconds_bucket", "0.5")]["type"] == "counter"
+    assert by[("h_seconds_count", None)]["type"] == "counter"
+    assert by[("h_seconds_sum", None)]["type"] == "counter"
+
+
+# ---------------------------------------------------------------------------
+# trace merging
+# ---------------------------------------------------------------------------
+
+
+def _doc(trace_id, ctx, process, spans, parent_ctx=None, **kw):
+    d = {"trace_id": trace_id, "name": spans[0]["name"], "ctx": ctx,
+         "process": process,
+         "head_sampled": kw.get("head_sampled", True),
+         "anomaly": kw.get("anomaly"),
+         "finished": kw.get("finished", True), "spans": spans}
+    if parent_ctx is not None:
+        d["parent_ctx"] = parent_ctx
+    return d
+
+
+def _span(span_id, parent_id, name, t0=0.0, t1=1.0, **attrs):
+    return {"span_id": span_id, "parent_id": parent_id, "name": name,
+            "t0": t0, "t1": t1, "attrs": attrs}
+
+
+def test_merge_single_doc_passes_through_untouched():
+    d = _doc("t1", "a.1", None, [_span(0, None, "serve.request")])
+    out = merge_fleet_traces([d])
+    assert out == [d] and out[0] is d
+    assert out[0]["spans"][0]["span_id"] == 0    # integer ids intact
+
+
+def test_merge_qualifies_ids_and_resolves_parent_ctx():
+    router = _doc("t1", "a.1", "router",
+                  [_span(0, None, "fleet.request"),
+                   _span(1, 0, "route")])
+    rep = _doc("t1", "b.9", "r0", [_span(0, None, "serve.request")],
+               parent_ctx="a.1/1", finished=False, anomaly="expired")
+    out = merge_fleet_traces([rep, router])    # order must not matter
+    assert len(out) == 1
+    doc = out[0]
+    assert doc["name"] == "fleet.request"
+    assert doc["merged_from"] == 2
+    assert doc["processes"] == ["router", "r0"]
+    assert doc["anomaly"] == "expired" and doc["finished"] is False
+    by_id = {s["span_id"]: s for s in doc["spans"]}
+    assert set(by_id) == {"a.1/0", "a.1/1", "b.9/0"}
+    assert by_id["b.9/0"]["parent_id"] == "a.1/1"
+    assert by_id["b.9/0"]["process"] == "r0"
+    assert by_id["a.1/1"]["parent_id"] == "a.1/0"
+
+
+def test_merge_unresolvable_parent_stays_root():
+    """The upstream buffer was lost (process died before dumping): the
+    orphan subtree still renders, parented at nothing."""
+    a = _doc("t1", "a.1", "r0", [_span(0, None, "serve.request")],
+             parent_ctx="gone.7/3")
+    b = _doc("t1", "b.2", "r1", [_span(0, None, "serve.request")],
+             parent_ctx="a.1/0")
+    doc = merge_fleet_traces([a, b])[0]
+    by_id = {s["span_id"]: s for s in doc["spans"]}
+    assert by_id["a.1/0"]["parent_id"] is None
+    assert by_id["b.2/0"]["parent_id"] == "a.1/0"
+
+
+def test_perfetto_renders_one_pid_per_process():
+    router = _doc("t1", "a.1", "router",
+                  [_span(0, None, "fleet.request")])
+    rep = _doc("t1", "b.9", "r0", [_span(0, None, "serve.request")],
+               parent_ctx="a.1/0")
+    doc = merge_fleet_traces([router, rep])[0]
+    perf = trace_mod.perfetto_doc([doc], include_host_timeline=False)
+    names = {e["args"]["name"] for e in perf["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"paddle_tpu.trace:router", "paddle_tpu.trace:r0"}
+    slices = [e for e in perf["traceEvents"] if e.get("ph") == "X"]
+    assert len({e["pid"] for e in slices}) == 2
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_targets_spec():
+    ts = parse_targets("a=http://h:1, http://h2:2/ ,")
+    assert [(t.name, t.url) for t in ts] \
+        == [("a", "http://h:1"), ("h2:2", "http://h2:2")]
+    assert parse_targets("") == []
+
+
+def test_federator_rejects_bad_target_sets():
+    with pytest.raises(ValueError, match="target"):
+        FleetFederator([])
+    t = FleetTarget("a", fetch_metrics=lambda: "")
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetFederator([t, FleetTarget("a", fetch_metrics=lambda: "")])
+
+
+def test_federator_sums_pages_under_host_labels():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.counter("serve_requests_total", "x").inc(10, event="completed")
+    r2.counter("serve_requests_total", "x").inc(5, event="completed")
+    r2.gauge("serve_queue_depth", "x").set(3)
+    fed = FleetFederator(
+        [FleetTarget("a", fetch_metrics=r1.to_prometheus,
+                     fetch_ready=lambda: True),
+         FleetTarget("b", fetch_metrics=r2.to_prometheus,
+                     fetch_ready=lambda: False)],
+        FederatorConfig(), clock=ManualClock(100.0))
+    s = fed.scrape_once()
+    assert s["targets_scraped"] == 2 and s["incident"] is None
+    by_host = {lb["host"]: v for lb, v in
+               fed.registry.get("serve_requests_total").samples()}
+    assert by_host == {"a": 10.0, "b": 5.0}
+    assert sum(by_host.values()) == 15.0      # page == sum of pages
+    assert fed._target_state == {"a": "ready", "b": "not_ready"}
+    states = {lb["state"]: v for lb, v in
+              fed.registry.get("fleet_replicas").samples()}
+    assert states["ready"] == 1 and states["not_ready"] == 1
+    assert lint_exposition(fed.registry.to_prometheus()) == []
+    # a later scrape REBUILDS: cumulative pages never double-count
+    fed.scrape_once()
+    assert fed.registry.get("serve_requests_total").value(
+        host="a", event="completed") == 10.0
+
+
+def test_federator_scrape_error_isolates_target():
+    good = MetricsRegistry()
+    good.counter("serve_requests_total", "x").inc(2, event="completed")
+
+    def boom():
+        raise OSError("connection refused")
+
+    fed = FleetFederator(
+        [FleetTarget("up", fetch_metrics=good.to_prometheus,
+                     fetch_ready=lambda: True),
+         FleetTarget("down", fetch_metrics=boom)],
+        FederatorConfig(), clock=ManualClock(1.0))
+    s = fed.scrape_once()
+    assert s["targets_scraped"] == 1
+    assert fed._target_state["down"] == "unreachable"
+    assert fed.registry.get("fleet_scrape_errors_total").value(
+        host="down") == 1.0
+    assert fed.registry.get("serve_requests_total").value(
+        host="up", event="completed") == 2.0
+
+
+def test_fleet_admin_quorum_readyz_and_statusz():
+    reg = MetricsRegistry()
+    reg.gauge("serve_queue_depth", "x").set(3)
+    reg.counter("serve_prefix_hits_total", "x").inc(3)
+    reg.counter("serve_prefix_misses_total", "x").inc(1)
+
+    def boom():
+        raise OSError("down")
+
+    fed = FleetFederator(
+        [FleetTarget("good", fetch_metrics=reg.to_prometheus,
+                     fetch_ready=lambda: True),
+         FleetTarget("dead", fetch_metrics=boom)],
+        FederatorConfig(quorum=2), port=0)
+    fed.start()
+    try:
+        fed.scrape_once()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(fed.url + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["reasons"]["fleet_quorum"]["ready"] == 1
+        with urllib.request.urlopen(fed.url + "/statusz",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        rows = doc["sections"]["fleet"]["targets"]
+        assert rows["good"]["state"] == "ready"
+        assert rows["good"]["queue_depth"] == 3.0
+        assert rows["good"]["prefix_hit_pct"] == pytest.approx(75.0)
+        assert rows["dead"]["state"] == "unreachable"
+        with urllib.request.urlopen(fed.url + "/metrics",
+                                    timeout=10) as r:
+            page = r.read().decode()
+        assert 'fleet_replicas{state="ready"} 1' in page
+    finally:
+        fed.close()
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(SCRAPE_THREAD_PREFIX)]
+
+
+def test_fleet_admin_serves_merged_traces():
+    """/debug/trace on the fleet plane returns MERGED docs — the
+    router's doc and a replica doc sharing a trace_id come back as one
+    tree (and ?format=perfetto renders per-process tracks)."""
+    tracer = trace_mod.get_tracer()
+    root = tracer.start_trace("fleet.request", process="router",
+                              sample=True)
+    child = tracer.start_trace("serve.request", trace_id=root.trace_id,
+                               process="r0", sample=True,
+                               parent=root.context_for())
+    tracer.finish_trace(child)
+    tracer.finish_trace(root)
+    fed = FleetFederator([local_registry_target()], FederatorConfig(),
+                         port=0)
+    fed.start()
+    try:
+        with urllib.request.urlopen(fed.url + "/debug/trace",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        merged = [t for t in doc["traces"]
+                  if t.get("trace_id") == root.trace_id]
+        assert len(merged) == 1
+        assert merged[0]["merged_from"] == 2
+        assert merged[0]["processes"] == ["router", "r0"]
+    finally:
+        fed.close()
+
+
+# ---------------------------------------------------------------------------
+# incident capture
+# ---------------------------------------------------------------------------
+
+
+def test_incident_capture_rate_limited(tmp_path):
+    reg = MetricsRegistry()
+    fed = FleetFederator(
+        [FleetTarget("a", fetch_metrics=reg.to_prometheus)],
+        FederatorConfig(incident_dir=str(tmp_path),
+                        incident_min_interval_s=300.0),
+        clock=ManualClock(1000.0))
+    fed.scrape_once()
+    d1 = fed.capture_incident("slo_burn", t=1000.0)
+    assert d1 is not None and os.path.isdir(d1)
+    assert fed.capture_incident("anomaly_trace", t=1100.0) is None
+    d3 = fed.capture_incident("anomaly_trace", t=1400.0)
+    assert d3 is not None
+    trig = {lb["trigger"]: v for lb, v in
+            fed._own.get("fleet_incidents_total").samples()}
+    assert trig == {"slo_burn": 1.0, "anomaly_trace": 1.0}
+    assert fed.incidents == [d1, d3]
+    for d in (d1, d3):
+        files = set(os.listdir(d))
+        assert {"incident.json", "statusz.json",
+                "metrics.prom"} <= files
+
+
+def test_incident_capture_off_without_dir(tmp_path):
+    fed = FleetFederator(
+        [FleetTarget("a", fetch_metrics=MetricsRegistry()
+                     .to_prometheus)],
+        FederatorConfig(), clock=ManualClock(1.0))
+    assert fed.capture_incident("slo_burn") is None
+    assert fed.incidents == []
+
+
+def test_anomaly_trace_triggers_incident(tmp_path):
+    """A tail-retained anomaly trace (the tracer kept an unsampled
+    trace because something went wrong) triggers one bundle on the next
+    scrape."""
+    fed = FleetFederator(
+        [FleetTarget("a", fetch_metrics=MetricsRegistry()
+                     .to_prometheus)],
+        FederatorConfig(incident_dir=str(tmp_path)),
+        clock=ManualClock(50.0))
+    fed.scrape_once()
+    tracer = trace_mod.get_tracer()
+    tr = tracer.start_trace("serve.request", sample=False)
+    tr.mark_anomaly("watchdog")
+    tracer.finish_trace(tr)
+    s = fed.scrape_once()
+    assert s["anomalies"] == 1
+    assert s["incident"] is not None \
+        and s["incident"].endswith("anomaly_trace")
+    # steady state: no new anomaly, no new bundle wanted
+    fed.config.incident_min_interval_s = 0.0
+    assert fed.scrape_once()["incident"] is None
+
+
+# ---------------------------------------------------------------------------
+# SLO feed over federated counters
+# ---------------------------------------------------------------------------
+
+
+def test_slo_feeds_from_federated_deltas_with_reset_folding():
+    reg = MetricsRegistry()
+    c = reg.counter("serve_requests_total", "x")
+    c.inc(90, event="completed")
+    c.inc(10, event="failed")
+    clock = ManualClock(0.0)
+    fed = FleetFederator(
+        [FleetTarget("a", fetch_metrics=reg.to_prometheus)],
+        FederatorConfig(slo_availability=0.99,
+                        slo_windows=(60.0, 600.0),
+                        alert_pairs=((600.0, 60.0, 1.0),)),
+        clock=clock)
+    s = fed.scrape_once()
+    assert fed.slo.total_good == 90 and fed.slo.total_bad == 10
+    assert s["alerts"]                      # 10% bad on a 1% budget
+    # replica restart: counters shrink; the fold records only the
+    # post-reset baseline, never a negative delta
+    reg.clear()
+    reg.counter("serve_requests_total", "x").inc(3, event="completed")
+    clock.advance(10.0)
+    fed.scrape_once()
+    assert fed.slo.total_good == 93 and fed.slo.total_bad == 10
+    # burn gauges rode into the federated page
+    assert fed.registry.get("slo_burn_rate") is not None
+
+
+# ---------------------------------------------------------------------------
+# flag gating / zero overhead
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_plane_zero_overhead_when_off():
+    assert maybe_start_from_flags() is None
+    assert get_federator() is None
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(SCRAPE_THREAD_PREFIX)]
+
+
+def test_maybe_start_from_flags_ephemeral_port():
+    with flag_scope("fleet_monitor_port", -1), \
+            flag_scope("fleet_monitor_interval_s", 30.0):
+        fed = maybe_start_from_flags()
+        assert fed is not None and fed.running
+        assert fed.url is not None
+        assert maybe_start_from_flags() is fed     # idempotent
+        # default targets: the local process registry under one host
+        assert [t.name for t in fed.targets] == ["fleet"]
+        fed.scrape_once()
+        with urllib.request.urlopen(fed.url + "/metrics",
+                                    timeout=10) as r:
+            page = r.read().decode()
+        assert "fleet_scrapes_total" in page
+    # the autouse _fleet_monitor_isolation fixture tears it down
+
+
+# ---------------------------------------------------------------------------
+# monitor_top --fleet pane
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_top_fleet_pane():
+    import monitor_top
+    clock = ManualClock()
+    ring = TimeseriesRing(clock=clock)
+    reg = MetricsRegistry()
+    reg.counter("serve_tokens_generated_total", "x").inc(100, host="r0")
+    reg.counter("serve_tokens_generated_total", "x").inc(40, host="r1")
+    reg.gauge("serve_queue_depth", "x").set(4, host="r0")
+    reg.gauge("serve_overload", "x").set(1, host="r1")
+    reg.gauge("fleet_replicas", "x").set(2, state="ready")
+    ring.ingest_rows(parse_prometheus(reg.to_prometheus()))
+    clock.advance(2.0)
+    reg.counter("serve_tokens_generated_total", "x").inc(60, host="r0")
+    ring.ingest_rows(parse_prometheus(reg.to_prometheus()))
+    frame = monitor_top.render_frame(ring, "http://f/metrics",
+                                     fleet=True)
+    assert "replica" in frame and "r0" in frame and "r1" in frame
+    assert "30.0" in frame                      # r0: 60 tokens over 2s
+    assert "OVERLOADED" in frame                # r1's state column
+    assert "ready 2" in frame
+
+
+def test_monitor_top_fleet_pane_empty_without_host_labels():
+    import monitor_top
+    ring = TimeseriesRing(clock=ManualClock())
+    reg = MetricsRegistry()
+    reg.counter("serve_tokens_generated_total", "x").inc(5)
+    ring.ingest_rows(parse_prometheus(reg.to_prometheus()))
+    assert monitor_top.render_fleet_pane(ring) == []

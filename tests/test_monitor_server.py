@@ -377,7 +377,11 @@ def test_parse_prometheus_roundtrip():
     assert d[("path_total", (("dir", "logs\\nightly"),))]["value"] == 1.0
     assert d[("b_depth", ())]["value"] == -1.5
     assert d[("c_seconds_count", ())]["type"] == "counter"
-    assert not any(n.endswith("_bucket") for n, _ in d)
+    # bucket rows survive the round-trip (ISSUE 18: the fleet
+    # federator re-assembles histograms from them) and type as the
+    # counters they are
+    assert d[("c_seconds_bucket", (("le", "0.1"),))]["value"] == 1.0
+    assert d[("c_seconds_bucket", (("le", "+Inf"),))]["type"] == "counter"
 
 
 # ---------------------------------------------------------------------------

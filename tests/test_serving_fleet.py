@@ -311,6 +311,211 @@ def test_router_rejects_mismatched_block_sizes(tiny_model):
     b.shutdown()
 
 
+def _merged_docs():
+    from paddle_tpu.monitor import trace as trace_mod
+    from paddle_tpu.monitor.fleet import merge_fleet_traces
+    return merge_fleet_traces(
+        trace_mod.get_tracer().snapshot(include_live=True))
+
+
+def test_router_trace_parents_replica_tree(tiny_model):
+    """ISSUE 18: one routed request produces ONE merged span tree — the
+    router's fleet.request root with its route span, and the replica's
+    serve.request tree parented UNDER the route decision (the Dapper
+    join the Request trace context carries)."""
+    with flag_scope("trace", True), flag_scope("trace_sample", 1.0):
+        router = _fleet(tiny_model, n=2)
+        rec = router.submit(Request(REP_PROMPT, max_new_tokens=4))
+        router.run()
+        router.shutdown()
+        docs = _merged_docs()
+    doc = next(d for d in docs if d["trace_id"] == rec.trace_id)
+    assert doc["name"] == "fleet.request"
+    assert doc["merged_from"] == 2 and doc["finished"]
+    assert doc["processes"][0] == "router"
+    spans = {s["span_id"]: s for s in doc["spans"]}
+    route = next(s for s in doc["spans"] if s["name"] == "route")
+    serve = next(s for s in doc["spans"]
+                 if s["name"] == "serve.request")
+    assert serve["parent_id"] == route["span_id"]
+    assert serve["process"] == rec.replica
+    assert route["attrs"]["replica"] == rec.replica
+    assert "affinity_key" in route["attrs"]
+    root = spans[route["parent_id"]]
+    assert root["name"] == "fleet.request"
+    assert root["attrs"]["outcome"] == "completed"
+    assert root["attrs"]["hops"] == 0
+
+
+def test_drain_trace_parent_follows_migrate_hop(tiny_model, tmp_path):
+    """Drain keeps ONE trace across the hop: the router opens a migrate
+    span, the propagated parent token moves to it, and the resumed
+    serve.request tree on the survivor parents under the hop."""
+    with flag_scope("trace", True), flag_scope("trace_sample", 1.0):
+        router = _fleet(tiny_model, n=2,
+                        router_kw=dict(drain_dir=str(tmp_path)))
+        rec = router.submit(Request(REP_PROMPT, max_new_tokens=8))
+        for _ in range(3):
+            router.step_all()
+        first_parent = rec.trace_parent
+        assert first_parent is not None
+        router.drain_replica(rec.replica, budget_s=0.0)
+        assert rec.trace_parent != first_parent   # re-parented at hop
+        router.run()
+        router.shutdown()
+        docs = _merged_docs()
+    doc = next(d for d in docs if d["trace_id"] == rec.trace_id)
+    assert doc["merged_from"] == 3            # router + both replicas
+    hop = next(s for s in doc["spans"] if s["name"] == "migrate")
+    assert hop["attrs"]["reason"] == "drain"
+    serves = [s for s in doc["spans"] if s["name"] == "serve.request"]
+    assert len(serves) == 2
+    assert hop["span_id"] in {s["parent_id"] for s in serves}
+
+
+def test_kill_replica_merged_trace_shows_hops(tiny_model):
+    """Replica death still reads as ONE distributed trace: a migrate
+    span with reason=death under the router root, the survivor's
+    serve.request under the hop, and the Perfetto rendering carries one
+    process track per participant."""
+    from paddle_tpu.monitor import trace as trace_mod
+
+    with flag_scope("trace", True), flag_scope("trace_sample", 1.0):
+        router = _fleet(tiny_model, n=2)
+        recs = [router.submit(Request(p, max_new_tokens=8))
+                for p in PROMPTS]
+        for _ in range(3):
+            router.step_all()
+        victim = next(r.replica for r in recs if not r.done)
+        moved = [r for r in recs
+                 if not r.done and r.replica == victim]
+        router.kill_replica(victim)
+        router.run()
+        router.shutdown()
+        docs = _merged_docs()
+        perf = trace_mod.perfetto_doc(docs,
+                                      include_host_timeline=False)
+    rec = moved[0]
+    assert rec.outcome == "completed" and rec.hops == 1
+    doc = next(d for d in docs if d["trace_id"] == rec.trace_id)
+    hop = next(s for s in doc["spans"] if s["name"] == "migrate")
+    assert hop["attrs"]["reason"] == "death"
+    serves = [s for s in doc["spans"] if s["name"] == "serve.request"]
+    assert hop["span_id"] in {s["parent_id"] for s in serves}
+    assert {s.get("process") for s in doc["spans"]} \
+        == {"router", victim, rec.replica}
+    tracks = {e["args"]["name"] for e in perf["traceEvents"]
+              if e.get("name") == "process_name"}
+    assert {"paddle_tpu.trace:router",
+            f"paddle_tpu.trace:{victim}",
+            f"paddle_tpu.trace:{rec.replica}"} <= tracks
+
+
+def test_fleet_observability_drill(tiny_model, tmp_path):
+    """The ISSUE 18 acceptance drill: tenanted traffic over a
+    2-replica fleet with a mid-flight replica kill and a deadline
+    blowout, a FleetFederator over the shared registry — the federated
+    page is lint-clean and sums to the source, the availability burn
+    fires exactly ONE rate-limited incident bundle, and the bundle
+    carries the merged fleet trace."""
+    import json
+    import os
+
+    from paddle_tpu.monitor import scoped_registry
+    from paddle_tpu.monitor.fleet import (FederatorConfig,
+                                          FleetFederator,
+                                          local_registry_target)
+    from paddle_tpu.monitor.metrics import lint_exposition
+
+    clk = [1000.0]
+    with scoped_registry() as reg, flag_scope("trace", True), \
+            flag_scope("trace_sample", 1.0):
+        router = _fleet(tiny_model, n=2)
+        fed = FleetFederator(
+            [local_registry_target("local")],
+            FederatorConfig(
+                slo_availability=0.9, slo_windows=(60.0, 600.0),
+                alert_pairs=((600.0, 60.0, 1.0),),
+                incident_dir=str(tmp_path),
+                incident_min_interval_s=300.0),
+            router=router, clock=lambda: clk[0])
+        recs = [router.submit(Request(p, max_new_tokens=6,
+                                      tenant=f"t{i % 2}"))
+                for i, p in enumerate(PROMPTS)]
+        for _ in range(3):
+            router.step_all()
+        victim = next(r.replica for r in recs if not r.done)
+        router.kill_replica(victim)
+        # one request past its deadline spends availability budget
+        # (expired is a BAD event in the federator's SLO vocabulary)
+        doomed = router.submit(Request(REP_PROMPT, max_new_tokens=4,
+                                       deadline_s=1e-6))
+        time.sleep(0.01)
+        router.run()
+        assert doomed.outcome == "expired"
+        assert all(r.outcome == "completed" for r in recs)
+
+        s1 = fed.scrape_once()
+        assert s1["targets_scraped"] == 1
+        assert s1["alerts"] and s1["incident"] is not None
+        clk[0] += 10.0
+        s2 = fed.scrape_once()
+        assert s2["incident"] is None        # inside the rate floor
+
+        page = fed.registry.to_prometheus()
+        assert lint_exposition(page) == []
+        # federated serve_requests_total == the source registry, and
+        # every federated serving sample carries the host label
+        src = {lb["event"]: float(v) for lb, v in
+               reg.snapshot()["serve_requests_total"]["samples"]}
+        fed_by_event = {}
+        for lb, v in fed.registry.get(
+                "serve_requests_total").samples():
+            assert lb["host"] == "local"
+            fed_by_event[lb["event"]] = \
+                fed_by_event.get(lb["event"], 0.0) + float(v)
+        assert fed_by_event == src
+        # tenant rollup crossed the federation boundary
+        tenants = fed._fleet_status()["tenants"]
+        assert set(tenants) >= {"t0", "t1"}
+        router.shutdown()
+
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("incident_")]
+    assert len(bundles) == 1 and bundles[0].endswith("slo_burn")
+    bundle = os.path.join(tmp_path, bundles[0])
+    files = set(os.listdir(bundle))
+    assert {"incident.json", "statusz.json", "metrics.prom",
+            "flight.json", "trace_perfetto.json"} <= files
+    with open(os.path.join(bundle, "incident.json")) as f:
+        inc = json.load(f)
+    assert inc["trigger"] == "slo_burn" and inc["alerts"]
+    with open(os.path.join(bundle, "trace_perfetto.json")) as f:
+        perf = json.load(f)
+    tracks = {e["args"]["name"] for e in perf["traceEvents"]
+              if e.get("name") == "process_name"}
+    assert "paddle_tpu.trace:router" in tracks
+
+
+def test_fleet_observability_off_by_default(tiny_model):
+    """Zero-overhead pin: with FLAGS_fleet_monitor_* at defaults the
+    router fast path allocates no federator, no scrape thread and no
+    spans."""
+    import threading
+
+    from paddle_tpu.monitor import trace as trace_mod
+    from paddle_tpu.monitor.fleet import (SCRAPE_THREAD_PREFIX,
+                                          get_federator)
+
+    router = _fleet(tiny_model, n=2)
+    router.generate([REP_PROMPT], max_new_tokens=3)
+    router.shutdown()
+    assert get_federator() is None
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(SCRAPE_THREAD_PREFIX)]
+    assert trace_mod.TRACE_STATS["spans_allocated"] == 0
+
+
 def test_fleet_gauges_published(tiny_model):
     """summary() publishes the per-replica gauges the --fleet report
     renders: queue depth, prefix hit%, shed, and fleet size by state."""

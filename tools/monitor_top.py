@@ -22,10 +22,18 @@ with ``--no-clear`` for dumb terminals / piped output), so it runs over
 any ssh session. Everything is computed from the scrape text — the tool
 never imports jax and works against any process exposing the format.
 
+With ``--fleet``, point it at a fleet federator
+(``FLAGS_fleet_monitor_port``; docs/OBSERVABILITY.md "Fleet
+observability") instead of a single process: the federated page is
+host-labelled, so the frame gains a **per-replica pane** — one row per
+replica with tokens/s, queue depth, KV pages in use and shed/overload
+state, plus the fleet totals the summary rows already show.
+
 Usage:
     python tools/monitor_top.py http://127.0.0.1:9090 [--interval 1.0]
     python tools/monitor_top.py http://host:port/metrics --iterations 30
     python tools/monitor_top.py --once http://127.0.0.1:9090
+    python tools/monitor_top.py --fleet http://127.0.0.1:9091
 
 Exit code: 0 (including Ctrl-C), 2 on usage errors. Scrape failures
 render as a banner and the loop keeps trying — a restarting server must
@@ -60,10 +68,57 @@ def scrape(url: str, timeout: float = 5.0) -> str:
         return r.read().decode("utf-8", "replace")
 
 
+def _fleet_hosts(ring) -> List[str]:
+    """Distinct host labels on the federated serving series."""
+    hosts = set()
+    for name in ("serve_queue_depth", "serve_tokens_generated_total",
+                 "serve_requests_total"):
+        for labels in ring.label_sets(name):
+            h = labels.get("host")
+            if h is not None:
+                hosts.add(h)
+    return sorted(hosts)
+
+
+def render_fleet_pane(ring,
+                      window_s: Optional[float] = None) -> List[str]:
+    """Per-replica rows off a federated (host-labelled) page. Pure
+    function of the ring — tests drive it without any HTTP. Empty when
+    the page carries no host labels (not a federator)."""
+    W = RATE_WINDOW_S if window_s is None else window_s
+    hosts = _fleet_hosts(ring)
+    if not hosts:
+        return []
+    lines = ["", "replica      tokens/s    queue   kv pages   "
+                 "shed/s   state"]
+    for h in hosts:
+        tok = ring.rate("serve_tokens_generated_total", W, host=h)
+        q = ring.latest("serve_queue_depth", host=h)
+        pages = ring.latest("serve_kv_pages_in_use", host=h)
+        shed = ring.rate("serve_requests_total", W, host=h,
+                         event="shed")
+        over = ring.latest("serve_overload", host=h)
+        state = ("OVERLOADED" if over else "ok") \
+            if over is not None else "-"
+        if shed:
+            state += " shedding"
+        lines.append(f"{h:<12} {_fmt(tok):>9}  {_fmt(q, '{:,.0f}'):>6}"
+                     f"  {_fmt(pages, '{:,.0f}'):>9}"
+                     f"  {_fmt(shed, '{:,.2f}'):>7}   {state}")
+    ready = ring.latest("fleet_replicas", state="ready")
+    unreach = ring.latest("fleet_replicas", state="unreachable")
+    if ready is not None or unreach is not None:
+        lines.append(f"fleet     ready {_fmt(ready, '{:,.0f}')}"
+                     f"   unreachable {_fmt(unreach, '{:,.0f}')}")
+    return lines
+
+
 def render_frame(ring, url: str, now: Optional[float] = None,
-                 error: Optional[str] = None) -> str:
+                 error: Optional[str] = None,
+                 fleet: bool = False) -> str:
     """One screen of movement from the ring's history. Pure function of
-    the ring — tests drive it without any HTTP."""
+    the ring — tests drive it without any HTTP. ``fleet=True`` appends
+    the per-replica pane (host-labelled federator pages)."""
     W = RATE_WINDOW_S
     lines: List[str] = []
     ts = time.strftime("%H:%M:%S",
@@ -139,6 +194,9 @@ def render_frame(ring, url: str, now: Optional[float] = None,
         lines.append("")
         lines.append("training  " + "   ".join(sorted(t_rows)))
 
+    if fleet:
+        lines.extend(render_fleet_pane(ring))
+
     if ring.snapshots_taken < 2:
         lines.append("")
         lines.append("(rates need two scrapes — hold on...)")
@@ -170,6 +228,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     no_clear = "--no-clear" in argv
     if no_clear:
         argv.remove("--no-clear")
+    fleet = "--fleet" in argv
+    if fleet:
+        argv.remove("--fleet")
     if len(argv) != 1:
         print(__doc__, file=sys.stderr)
         return 2
@@ -191,7 +252,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ring.ingest_rows(parse_prometheus(scrape(url)))
             except (urllib.error.URLError, OSError, ValueError) as e:
                 err = str(e)
-            frame = render_frame(ring, url, error=err)
+            frame = render_frame(ring, url, error=err, fleet=fleet)
             sys.stdout.write(frame if no_clear else _CLEAR + frame)
             sys.stdout.flush()
             n += 1
