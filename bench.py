@@ -2122,6 +2122,94 @@ def run_serve_mode(quick: bool) -> None:
     write_gated_record("BENCH_serve.json", metrics)
 
 
+def bench_train_goodput(quick: bool) -> list:
+    """--train: goodput-ledger + model-health overhead on a small MLP
+    TrainStep. Warm step time with the ledger on and health telemetry
+    OFF vs ``FLAGS_train_health_every=1`` (per-layer grad/param/update
+    side-outputs compiled INTO the step program — the contract is that
+    the cost is compiled arithmetic, not extra dispatches), gated as
+    absolute points. Also emits the run's ``train_goodput_pct`` under
+    the higher-is-better ``goodput%`` unit so a leak of wall-clock into
+    a badput bucket trips check_bench even when step time survives."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn
+    from paddle_tpu.jit.to_static import TrainStep
+    from paddle_tpu.monitor import goodput as goodput_mod
+
+    iters = 10 if quick else 40
+    paddle.set_flags({"train_goodput": True, "train_health_every": 0})
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                          nn.Linear(128, 64), nn.ReLU(),
+                          nn.Linear(64, 8))
+    step = TrainStep(model, lambda l, a, b: F.cross_entropy(l(a), b),
+                     paddle.optimizer.Adam(
+                         learning_rate=1e-3,
+                         parameters=model.parameters()))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 64)).astype(np.float32)
+    y = rng.integers(0, 8, (32,)).astype(np.int64)
+
+    float(step(x, y))                    # compile + step 1
+    for _ in range(3):
+        loss = step(x, y)
+    float(loss)
+    ms_off = steady_ms(lambda: step(x, y), iters=iters)
+
+    # health at every step is the worst-case telemetry load; production
+    # cadence (every-N) can only cost less readback, same program
+    paddle.set_flags({"train_health_every": 1})
+    float(step(x, y))                    # health program compile
+    for _ in range(3):
+        loss = step(x, y)
+    float(loss)
+    ms_on = steady_ms(lambda: step(x, y), iters=iters)
+    paddle.set_flags({"train_health_every": 0})
+
+    overhead = max(0.0, (ms_on - ms_off) / ms_off * 100.0)
+    led = goodput_mod.active_ledger()
+    snap = led.snapshot() if led is not None else {}
+    gp = float(snap.get("goodput_pct", 0.0))
+    log(f"train: warm step health-off {ms_off:.3f} ms, health-every-1 "
+        f"{ms_on:.3f} ms -> overhead {overhead:.1f} points "
+        f"(goodput {gp:.1f}% of {snap.get('elapsed_s', 0.0):.1f}s)")
+    for b, s in sorted((snap.get("buckets") or {}).items(),
+                       key=lambda kv: -kv[1]):
+        if s:
+            log(f"train:   {b:<20} {s:8.2f}s")
+    return [metric_line("train_goodput_pct", gp, "goodput%",
+                        vs_baseline=1.0),
+            metric_line("train_goodput_overhead_pct", overhead,
+                        "overhead%", vs_baseline=1.0,
+                        ms_off=ms_off, ms_on=ms_on)]
+
+
+def run_train_mode(quick: bool) -> None:
+    """--train: emit ONLY the goodput metric lines (one JSON per line),
+    write/self-gate the BENCH_train.json record (full runs), and dump
+    the monitor registry (goodput gauge/badput counters + per-layer
+    health gauges — tools/monitor_report.py --goodput renders it) —
+    same contract as --serve."""
+    import os
+    metrics = bench_train_goodput(quick=quick)
+    for m in metrics:
+        print(json.dumps(m), flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from paddle_tpu.monitor import get_registry
+        mpath = os.path.join(here, "BENCH_monitor.jsonl")
+        get_registry().dump_jsonl(mpath, extra={"source": "bench_train"})
+        log(f"monitor: registry dumped to {mpath} "
+            "(render: python tools/monitor_report.py --goodput)")
+    except Exception as e:
+        log(f"monitor dump skipped: {e!r}")
+    if quick:
+        log("train: --quick run, BENCH_train.json not written")
+        return
+    write_gated_record("BENCH_train.json", metrics)
+
+
 def main() -> None:
     import jax
     # rbg keys: dropout mask generation is ~10x cheaper than threefry on
@@ -2178,6 +2266,11 @@ def main() -> None:
         # giant-embedding DLRM training + online ranking record
         # (BENCH_recsys)
         run_recsys_mode(quick=not full)
+        return
+    if "--train" in sys.argv:
+        # training goodput ledger + model-health overhead record
+        # (BENCH_train)
+        run_train_mode(quick=not full)
         return
     metrics = []
 

@@ -446,6 +446,28 @@ define_flag("fleet_monitor_incident_dir", "",
             "into a timestamped incident_* subdir (rate-limited; "
             "bundle dirs are .gitignore'd). Empty (default) = no "
             "incident capture.")
+define_flag("train_goodput", False,
+            "Training goodput ledger (monitor/goodput.py): attribute "
+            "every second of trainer wall-clock to one exclusive "
+            "bucket (productive_dispatch / compile / data_wait / "
+            "checkpoint_stall / nonfinite_rollback / restart_gap / "
+            "host_other), persist the totals in the CheckpointManager "
+            "sidecar across SIGTERM->resume, and publish "
+            "train_goodput_pct + train_badput_seconds_total{bucket} "
+            "under FLAGS_monitor. Off (default) = one flag read per "
+            "seam, no ledger allocation, no registry series — the "
+            "zero-overhead contract, pinned by tests/test_goodput.py.")
+define_flag("train_health_every", 0,
+            "Per-layer model-health telemetry cadence: N > 0 compiles "
+            "f32 per-layer grad-norm / param-norm / update-ratio "
+            "side-outputs INTO the train step program (no extra "
+            "dispatch; scan-over-layers stacks keep their per-layer "
+            "param names) and publishes train_layer_* gauges every N "
+            "optimizer steps, with an EWMA spike detector that "
+            "tail-marks the step trace (reason 'health_spike') and "
+            "attaches the last vector to flight-recorder dumps. "
+            "0 (default) = OFF: the step program is bit-identical and "
+            "nothing is computed or published.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

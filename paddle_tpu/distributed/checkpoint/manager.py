@@ -110,20 +110,33 @@ class CheckpointManager:
         the final committed path). Synchronous saves are durable on
         return; async saves are durable after the next ``wait()``/save
         (or the final preemption commit)."""
+        from ...monitor import goodput as _goodput
         from . import save_train_step
         if dataloader_state is not None:
             self._dataloader_state = dataloader_state
         n = int(self._step.step_count)
         path = self.step_dir(n)
-        sidecar = json.dumps({
+        state = {
             "step": n,
             "saved_at": time.time(),
             "dataloader": self._dataloader_state,
-        }, indent=1)
+        }
+        led = _goodput.active_ledger()
+        if led is not None:
+            # the goodput ledger rides the sidecar: train_goodput_pct
+            # survives SIGTERM → resume, and resume() attributes the
+            # restart gap (docs/OBSERVABILITY.md)
+            state["goodput"] = led.state()
+        sidecar = json.dumps(state, indent=1)
         asynchronous = (self.asynchronous if asynchronous is None
                         else asynchronous)
-        save_train_step(self._step, path, asynchronous=asynchronous,
-                        extra_files={MANAGER_STATE_NAME: sidecar})
+        # sync saves (interval sync mode, the preemption final commit)
+        # block training — checkpoint_stall badput. Async enqueue time
+        # is accounted too: near-zero when healthy, and a torn/stuck
+        # write surfaces in the same bucket instead of vanishing.
+        with _goodput.measure("checkpoint_stall"):
+            save_train_step(self._step, path, asynchronous=asynchronous,
+                            extra_files={MANAGER_STATE_NAME: sidecar})
         if not asynchronous:
             self.gc()
         self.save_count += 1
@@ -131,8 +144,10 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Finalize pending async saves (commit + error propagation)."""
+        from ...monitor import goodput as _goodput
         from . import wait as ckpt_wait
-        ckpt_wait()
+        with _goodput.measure("checkpoint_stall"):
+            ckpt_wait()
 
     def on_step(self, dataloader_state: Optional[dict] = None) \
             -> Optional[str]:
@@ -216,6 +231,17 @@ class CheckpointManager:
                 continue
             meta = self._read_sidecar(path)
             self._dataloader_state = (meta or {}).get("dataloader")
+            saved_goodput = (meta or {}).get("goodput")
+            if saved_goodput:
+                from ...monitor import goodput as _goodput
+                led = _goodput.active_ledger()
+                if led is not None:
+                    # carry the previous incarnation's bucket totals
+                    # forward and attribute the dead time since its
+                    # final commit to restart_gap
+                    gap = led.restore(saved_goodput)
+                    logger.info("goodput ledger restored (restart gap "
+                                "%.1fs attributed)", gap)
             logger.info("resumed from %s (step %d)", path, n)
             result = {"step": n, "path": path,
                       "dataloader": self._dataloader_state}

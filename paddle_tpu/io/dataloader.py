@@ -315,11 +315,16 @@ class _DevicePrefetcher:
             self.buffer.append(self._convert(batch))
 
     def __next__(self):
-        if not self.buffer:
-            raise StopIteration
-        out = self.buffer.pop(0)
-        self._fill()
-        return out
+        # the consumer-facing wait: refill time IS the host-input-
+        # pipeline time the training loop sits in — the goodput
+        # ledger's data_wait bucket (one flag read when off)
+        from ..monitor import goodput as _goodput
+        with _goodput.measure("data_wait"):
+            if not self.buffer:
+                raise StopIteration
+            out = self.buffer.pop(0)
+            self._fill()
+            return out
 
     def __iter__(self):
         return self
@@ -380,14 +385,16 @@ class DataLoader:
                 self.it = it
 
             def __next__(self):
-                batch = next(self.it)
-                def conv(x):
-                    if isinstance(x, np.ndarray):
-                        return Tensor(x)
-                    if isinstance(x, (tuple, list)):
-                        return type(x)(conv(i) for i in x)
-                    return x
-                return conv(batch)
+                from ..monitor import goodput as _goodput
+                with _goodput.measure("data_wait"):
+                    batch = next(self.it)
+                    def conv(x):
+                        if isinstance(x, np.ndarray):
+                            return Tensor(x)
+                        if isinstance(x, (tuple, list)):
+                            return type(x)(conv(i) for i in x)
+                        return x
+                    return conv(batch)
 
             def __iter__(self):
                 return self

@@ -237,6 +237,51 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     return _decorate
 
 
+def _layer_key(name: str) -> str:
+    """Group a state-dict parameter name into its layer bucket: the
+    prefix up to and including the first numeric path component
+    (``layers.0.attn.qkv_weight`` → ``layers.0``), else the first
+    component (``embed.weight`` → ``embed``). Scan-over-layers keeps
+    per-layer state-dict names (nn/scan.py stacks at trace time only),
+    so the grouping is layout-invariant."""
+    parts = name.split(".")
+    for i, p in enumerate(parts[:-1]):
+        if p.isdigit():
+            return ".".join(parts[:i + 1])
+    return parts[0]
+
+
+def _layer_health_outputs(old_params, new_params, grads):
+    """Per-layer f32 health vectors computed INSIDE the compiled step
+    (FLAGS_train_health_every): grad norm, post-update param norm, and
+    the update ratio ||new-old|| / (||old|| + eps) — the classic
+    training-health triple. A handful of reductions fused into the step
+    program; no extra dispatch."""
+    groups: Dict[str, list] = {}
+    for k in grads:
+        groups.setdefault(_layer_key(k), []).append(k)
+
+    def sumsq(tree, ks):
+        tot = jnp.zeros((), jnp.float32)
+        for k in ks:
+            a = tree[k]
+            tot = tot + jnp.sum(jnp.square(a.astype(jnp.float32)))
+        return tot
+
+    out = {}
+    for layer, ks in sorted(groups.items()):
+        old_norm = jnp.sqrt(sumsq(old_params, ks))
+        upd = jnp.sqrt(sum(
+            jnp.sum(jnp.square((new_params[k] - old_params[k]
+                                ).astype(jnp.float32))) for k in ks))
+        out[layer] = {
+            "grad_norm": jnp.sqrt(sumsq(grads, ks)),
+            "param_norm": jnp.sqrt(sumsq(new_params, ks)),
+            "update_ratio": upd / (old_norm + 1e-12),
+        }
+    return out
+
+
 def _donation_safe() -> bool:
     """jax 0.4.37 XLA:CPU hazard: executables reloaded from the PERSISTENT
     compilation cache can lose the input-output aliasing of donated
@@ -390,7 +435,10 @@ class TrainStep:
         self._kinds_compiled: set = set()
         self._stats = {"compiles": 0, "recompiles": 0,
                        "grad_accum_syncs": 0, "nonfinite_trips": 0,
-                       "nonfinite_skips": 0}
+                       "nonfinite_skips": 0, "health_spikes": 0}
+        # EWMA spike detector over the per-layer health side-outputs;
+        # allocated on the first publish (FLAGS_train_health_every > 0)
+        self._health_mon = None
         # per-program-kind attribution (ISSUE 4): cost from
         # lowered.cost_analysis(), HBM budget from
         # compiled.memory_analysis() — captured once per compile (never
@@ -420,6 +468,8 @@ class TrainStep:
                     f"train_step-{id(self)}",
                     lambda: (lambda s: s.stats() if s is not None
                              else stale)(ref()))
+                from ..monitor import goodput as _goodput
+                srv.register_status("goodput", _goodput.statusz_section)
         from ..core.tensor import eager_cache_stats
         from ..utils.compilation import compile_counts
         self._cc0 = compile_counts()
@@ -558,7 +608,8 @@ class TrainStep:
 
         return run
 
-    def _make_step(self, treedef, training=True, check_finite=False):
+    def _make_step(self, treedef, training=True, check_finite=False,
+                   health=False):
         optimizer = self.optimizer
         run = self._loss_and_grads(treedef)
 
@@ -566,6 +617,7 @@ class TrainStep:
             (loss, new_bufs), grads = run(params, buffers, key, flat_batch)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr, t)
+            out = (new_params, new_bufs, new_opt, loss)
             if check_finite:
                 # NaN/Inf debug under jit (reference: FLAGS_check_nan_inf +
                 # nan_inf_utils: per-op device-side scan; here per-gradient
@@ -573,8 +625,13 @@ class TrainStep:
                 flags = {"loss": jnp.isfinite(loss)}
                 for k, g in grads.items():
                     flags["grad:" + k] = jnp.isfinite(g).all()
-                return new_params, new_bufs, new_opt, loss, flags
-            return new_params, new_bufs, new_opt, loss
+                out = out + (flags,)
+            if health:
+                # FLAGS_train_health_every: per-layer health vectors as
+                # side-outputs of the SAME program (always last element)
+                out = out + (_layer_health_outputs(params, new_params,
+                                                   grads),)
+            return out
 
         return step
 
@@ -594,7 +651,7 @@ class TrainStep:
 
         return step
 
-    def _make_apply_step(self, treedef, check_finite=False):
+    def _make_apply_step(self, treedef, check_finite=False, health=False):
         optimizer = self.optimizer
         k = self.grad_accum_steps
         avg = self.grad_accum_avg
@@ -608,12 +665,18 @@ class TrainStep:
             new_params, new_opt = optimizer.apply_gradients(
                 params, total, opt_state, lr, t)
             zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            out = (new_params, new_bufs, new_opt, zero, loss)
             if check_finite:
                 flags = {"loss": jnp.isfinite(loss)}
                 for key_, g in total.items():
                     flags["grad:" + key_] = jnp.isfinite(g).all()
-                return new_params, new_bufs, new_opt, zero, loss, flags
-            return new_params, new_bufs, new_opt, zero, loss
+                out = out + (flags,)
+            if health:
+                # health rides the optimizer-update boundary only: the
+                # MERGED gradient is the one the update consumed
+                out = out + (_layer_health_outputs(params, new_params,
+                                                   total),)
+            return out
 
         return step
 
@@ -657,12 +720,14 @@ class TrainStep:
         lower/compile + sharding-drift self-heal machinery lives in
         :class:`paddle_tpu.jit.aot.AOTProgram` (shared with the serving
         engine's bucketed signatures)."""
+        from ..monitor import goodput as _goodput
         from .aot import AOTProgram
-        return AOTProgram(
-            kind, fn, donate_argnums=donate_argnums,
-            on_attribute=lambda k, lowered, compiled:
-                self._attribute_program(k, lowered, compiled, mon),
-        ).compile(example_args)
+        with _goodput.measure("compile"):
+            return AOTProgram(
+                kind, fn, donate_argnums=donate_argnums,
+                on_attribute=lambda k, lowered, compiled:
+                    self._attribute_program(k, lowered, compiled, mon),
+            ).compile(example_args)
 
     def _attribute_program(self, kind: str, lowered, compiled, mon: bool):
         """Capture per-program FLOPs/bytes and the static HBM budget,
@@ -711,6 +776,11 @@ class TrainStep:
         self._wall_ema[kind] = wall if prev is None \
             else 0.8 * prev + 0.2 * wall
         reg = get_registry()
+        # goodput metrics ride the same monitor-mode publish cadence
+        from ..monitor import goodput as _goodput
+        led = _goodput.active_ledger()
+        if led is not None:
+            led.publish(reg)
         reg.counter("train_step_steps_total",
                     "TrainStep calls by program kind").inc(kind=kind)
         reg.histogram("train_step_dispatch_seconds",
@@ -737,6 +807,54 @@ class TrainStep:
                       "model FLOPs utilization by program kind (wall "
                       "EMA vs chip peak)").set(
                 flops / (self._wall_ema[kind] * peak), kind=kind)
+
+    def _publish_health(self, hvec, mon: bool):
+        """Host side of the per-layer health pipeline, every
+        FLAGS_train_health_every optimizer steps: read the f32 scalars
+        back (the ONLY extra device sync of the feature, at publish
+        cadence), publish train_layer_* gauges (monitor mode), run the
+        EWMA spike detector, tail-mark the step trace and feed the
+        flight recorder on a spike."""
+        from ..monitor import goodput as _goodput
+        host = {layer: {k: float(v) for k, v in vals.items()}
+                for layer, vals in hvec.items()}
+        _goodput.note_layer_health(host, step=self.step_count)
+        if self._health_mon is None:
+            self._health_mon = _goodput.LayerHealthMonitor()
+        spikes = self._health_mon.observe(host)
+        if mon:
+            from ..monitor import get_registry
+            reg = get_registry()
+            g = reg.gauge("train_layer_grad_norm",
+                          "per-layer gradient L2 norm (f32 side-output "
+                          "of the compiled step; "
+                          "FLAGS_train_health_every)")
+            p = reg.gauge("train_layer_param_norm",
+                          "per-layer post-update parameter L2 norm")
+            u = reg.gauge("train_layer_update_ratio",
+                          "per-layer ||update|| / ||param|| — the "
+                          "classic learning-rate health signal")
+            for layer, vals in host.items():
+                g.set(vals["grad_norm"], layer=layer)
+                p.set(vals["param_norm"], layer=layer)
+                u.set(vals["update_ratio"], layer=layer)
+        if spikes:
+            self._stats["health_spikes"] += len(spikes)
+            from ..monitor import trace as trace_mod
+            cur = trace_mod.current_trace()
+            if cur is not None:
+                cur.mark_anomaly("health_spike", step=self.step_count,
+                                 layers=sorted(spikes))
+            if mon:
+                from ..monitor import get_registry
+                ctr = get_registry().counter(
+                    "train_health_spikes_total",
+                    "per-layer grad-norm EWMA spike detections")
+                for layer in spikes:
+                    ctr.inc(layer=layer)
+            from ..monitor.flight_recorder import safe_record_event
+            safe_record_event("health_spike", step=self.step_count,
+                              layers=sorted(spikes))
 
     #: _step_span RecordEvent name -> structured-trace span name (the
     #: step-trace taxonomy of docs/OBSERVABILITY.md: dispatch /
@@ -785,6 +903,25 @@ class TrainStep:
         if bool(jnp.isfinite(loss).all()):
             self._consecutive_skips = 0
             return
+        # goodput: a rolled-back step made no progress — move its
+        # dispatch seconds out of productive_dispatch and attribute the
+        # whole trip handling (diagnosis pass, rollback) to
+        # nonfinite_rollback
+        from ..monitor import goodput as _goodput
+        led = _goodput.active_ledger()
+        if led is None:
+            return self._watchdog_trip(loss, prev_params, prev_buffers,
+                                       key, flat, treedef, step_index,
+                                       step_kind, rollback)
+        led.reattribute_last("nonfinite_rollback")
+        with led.measure("nonfinite_rollback"):
+            return self._watchdog_trip(loss, prev_params, prev_buffers,
+                                       key, flat, treedef, step_index,
+                                       step_kind, rollback)
+
+    def _watchdog_trip(self, loss, prev_params, prev_buffers, key, flat,
+                       treedef, step_index: int, step_kind: str,
+                       rollback):
         self._stats["nonfinite_trips"] += 1
         from ..monitor import trace as trace_mod
         cur_trace = trace_mod.current_trace()
@@ -923,11 +1060,19 @@ class TrainStep:
         seen = d["eager_cache_hits"] + d["eager_cache_misses"]
         d["eager_cache_hit_rate"] = (d["eager_cache_hits"] / seen
                                      if seen else None)
+        # the goodput ledger view, so single-process trainers (and the
+        # /statusz TrainStep.stats() section) see it without the admin
+        # plane; absent with FLAGS_train_goodput off
+        from ..monitor import goodput as _goodput
+        led = _goodput.get_ledger()
+        if led is not None and _goodput.active():
+            d["goodput"] = led.snapshot()
         return d
 
     def _call_accum(self, flat, treedef, check, mon, fr, t_wall):
         """Gradient-merge path: k-1 accumulate-only microsteps, then one
         accumulate+update microstep."""
+        from ..core.flags import get_flag
         if self._acc_grads is None:
             self._acc_grads = jax.tree_util.tree_map(
                 jnp.zeros_like, self.params)
@@ -951,9 +1096,12 @@ class TrainStep:
                     (self.params, self.buffers, self._acc_grads, key,
                      flat), mon)
                 self._jitted[sig] = jitted
+            from ..monitor import goodput as _goodput
             t0 = time.perf_counter() if mon else 0.0
             with _control_flow_guidance(), self._step_span(
-                    mon, "TrainStep.accum_microstep"):
+                    mon, "TrainStep.accum_microstep"), \
+                    _goodput.measure("productive_dispatch",
+                                     on_error="host_other"):
                 self.buffers, self._acc_grads, loss = self._dispatch(
                     jitted, self.params, self.buffers, self._acc_grads,
                     key, flat)
@@ -981,20 +1129,26 @@ class TrainStep:
         self.step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
-        sig = ("apply", _sig_of(flat)[0], treedef, check)
+        health_every = int(get_flag("train_health_every") or 0)
+        health = health_every > 0
+        sig = ("apply", _sig_of(flat)[0], treedef, check, health)
         jitted = self._jitted.get(sig)
         if jitted is None:
             self._note_compile("apply", mon, fr)
-            fn = self._make_apply_step(treedef, check_finite=check)
+            fn = self._make_apply_step(treedef, check_finite=check,
+                                       health=health)
             jitted = self._compile_program(
                 "apply", fn,
                 (0, 2, 3) if self._donate and _donation_safe() else (),
                 (self.params, self.buffers, self.opt_state,
                  self._acc_grads, lr, t, key, flat), mon)
             self._jitted[sig] = jitted
+        from ..monitor import goodput as _goodput
         t0 = time.perf_counter() if mon else 0.0
         with _control_flow_guidance(), self._step_span(
-                mon, "TrainStep.grad_accum_sync"):
+                mon, "TrainStep.grad_accum_sync"), \
+                _goodput.measure("productive_dispatch",
+                                 on_error="host_other"):
             out = self._dispatch(jitted, self.params, self.buffers,
                                  self.opt_state, self._acc_grads, lr, t,
                                  key, flat)
@@ -1009,6 +1163,9 @@ class TrainStep:
             get_registry().counter(
                 "train_step_grad_accum_syncs_total",
                 "gradient-accumulation optimizer-update boundaries").inc()
+        hvec = None
+        if health:
+            hvec, out = out[-1], out[:-1]
         if check:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss, flags) = out
@@ -1020,6 +1177,8 @@ class TrainStep:
         else:
             (self.params, self.buffers, self.opt_state, self._acc_grads,
              loss) = out
+        if hvec is not None and self.step_count % health_every == 0:
+            self._publish_health(hvec, mon)
         if _chaos.active() and _chaos.probe("grad.nonfinite"):
             loss = jnp.full_like(loss, jnp.nan)
         if fr:
@@ -1050,6 +1209,17 @@ class TrainStep:
         # whatever FLAGS_trace_sample said.
         tr = trace_mod.get_tracer().start_trace(
             "train.step", step=self.step_count + 1)
+        # the wait for THIS step's batch happened before the trace
+        # existed — attach it retroactively with explicit timestamps
+        # (same perf_counter clock) so where-did-the-time-go reads on
+        # one timeline: data_wait → dispatch → sync
+        from ..monitor import goodput as _goodput
+        led = _goodput.get_ledger()
+        if led is not None and _goodput.active():
+            dw = led.pop_pending_data_wait()
+            if dw is not None:
+                sp = tr.start_span("data_wait", t=dw[0])
+                tr.end_span(sp, t=dw[1])
         try:
             with trace_mod.activate(tr):
                 return self._call_impl(*batch)
@@ -1077,11 +1247,17 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.step_count, jnp.int32)
         key = make_rng("train_step")
-        sig = (_sig_of(flat)[0], treedef, check)
+        # health folds into the jit-cache signature: flag off keeps the
+        # exact program (and dispatch args) of every prior PR — the
+        # zero-overhead pin; flag on only ADDS f32 scalar outputs
+        health_every = int(get_flag("train_health_every") or 0)
+        health = health_every > 0
+        sig = (_sig_of(flat)[0], treedef, check, health)
         jitted = self._jitted.get(sig)
         if jitted is None:
             self._note_compile("step", mon, fr)
-            fn = self._make_step(treedef, check_finite=check)
+            fn = self._make_step(treedef, check_finite=check,
+                                 health=health)
             donate = (0, 2) if self._donate and _donation_safe() else ()
             jitted = self._compile_program(
                 "step", fn, donate,
@@ -1091,13 +1267,19 @@ class TrainStep:
         watch = bool(self._check_numerics) or self.skip_nonfinite_budget > 0
         prev = ((self.params, self.buffers, self.opt_state) if watch
                 else None)
+        from ..monitor import goodput as _goodput
         t0 = time.perf_counter() if mon else 0.0
-        with _control_flow_guidance(), self._step_span(mon):
+        with _control_flow_guidance(), self._step_span(mon), \
+                _goodput.measure("productive_dispatch",
+                                 on_error="host_other"):
             out = self._dispatch(jitted, self.params, self.buffers,
                                  self.opt_state, lr, t, key, flat)
         dispatch_s = time.perf_counter() - t0 if mon else None
         if mon:
             self._record_step_metrics(t_wall, dispatch_s)
+        hvec = None
+        if health:
+            hvec, out = out[-1], out[:-1]
         if check:
             self.params, self.buffers, self.opt_state, loss, flags = out
             bad = [k for k, ok in flags.items() if not bool(ok)]
@@ -1107,6 +1289,8 @@ class TrainStep:
                     f"{', '.join(sorted(bad))} (FLAGS_check_nan_inf)")
         else:
             self.params, self.buffers, self.opt_state, loss = out
+        if hvec is not None and self.step_count % health_every == 0:
+            self._publish_health(hvec, mon)
         if _chaos.active() and _chaos.probe("grad.nonfinite"):
             loss = jnp.full_like(loss, jnp.nan)
         if fr:

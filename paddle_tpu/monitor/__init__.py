@@ -68,6 +68,22 @@ Fleet observability plane (ISSUE 18 — one pane for many processes):
     under one trace_id), fleet SLO burn over the federated counters,
     and anomaly-triggered, rate-limited incident bundles.
 
+Training goodput & model health (ISSUE 19 — the training-side plane):
+
+13. the **goodput ledger** (:mod:`.goodput`, ``FLAGS_train_goodput``):
+    every second of trainer wall-clock attributed to ONE exclusive
+    bucket (productive_dispatch / compile / data_wait /
+    checkpoint_stall / nonfinite_rollback / restart_gap / host_other),
+    persisted across SIGTERM→resume through the CheckpointManager
+    sidecar, published as ``train_goodput_pct`` +
+    ``train_badput_seconds_total{bucket}`` with a /statusz section and
+    ``data_wait`` spans on the step trace;
+14. **per-layer model health** (``FLAGS_train_health_every``): f32
+    grad-norm / param-norm / update-ratio side-outputs compiled into
+    the step program (scan layouts included), ``train_layer_*`` gauges,
+    and the :class:`~.goodput.LayerHealthMonitor` EWMA spike detector
+    that tail-marks step traces and feeds flight-recorder dumps.
+
 The registry is always importable and writable; the HOT paths only write
 to it when ``FLAGS_monitor`` is set (zero-overhead default, pinned by
 the write_count guard in tests/test_monitor.py; the flight recorder has
@@ -75,8 +91,9 @@ the same contract via ``FLAGS_flight_recorder`` and its
 ``record_count`` probe).
 """
 
-from . import (fleet, flight_recorder, memory, slo,  # noqa: F401
-               timeseries, trace)
+from . import (fleet, flight_recorder, goodput, memory,  # noqa: F401
+               slo, timeseries, trace)
+from .goodput import GoodputLedger, LayerHealthMonitor  # noqa: F401
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               get_flight_recorder, set_flight_recorder)
 from .memory import (LeakMonitor, MemoryBudgetError,  # noqa: F401
@@ -106,6 +123,7 @@ __all__ = [
     "Span", "Trace", "Tracer", "get_tracer", "set_tracer",
     "start_trace", "export_perfetto", "SLOTracker",
     "FleetFederator", "merge_fleet_traces",
+    "GoodputLedger", "LayerHealthMonitor",
 ]
 
 

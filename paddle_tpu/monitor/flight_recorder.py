@@ -61,7 +61,7 @@ RECOVERY_EVENTS = ("checkpoint_commit", "checkpoint_fallback",
                    "trip", "chaos", "request_failed", "request_expired",
                    "request_cancelled", "request_drained", "request_shed",
                    "decode_watchdog", "overload", "drained",
-                   "replica_migration")
+                   "replica_migration", "health_spike")
 
 
 # dump-time attachment hooks: other forensic subsystems (the structured

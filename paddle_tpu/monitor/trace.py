@@ -61,7 +61,7 @@ __all__ = [
 #: anomaly reasons that force tail-retention of a trace (the serving /
 #: training failure modes a post-mortem starts from)
 ANOMALY_REASONS = ("expired", "shed", "failed", "watchdog", "chaos",
-                   "nonfinite")
+                   "nonfinite", "health_spike")
 
 #: allocation probe: the zero-overhead pin reads spans_allocated == 0
 #: with FLAGS_trace off (tests/test_trace.py)

@@ -180,6 +180,20 @@ def _trace_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _goodput_isolation():
+    """Goodput-ledger module state (the process ledger, GOODPUT_STATS
+    allocation probe, last layer-health vector, dump-provider
+    registrations) must not leak between tests — the zero-overhead pin
+    reads the probe from a clean 0. Only touches the module when a test
+    imported it."""
+    import sys
+    yield
+    mod = sys.modules.get("paddle_tpu.monitor.goodput")
+    if mod is not None:
+        mod.reset()
+
+
+@pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle
     paddle.seed(1234)

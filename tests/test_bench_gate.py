@@ -221,6 +221,23 @@ def test_serve_prefix_hit_rides_hit_pct_unit():
     assert check_bench.compare(old, old, tolerance=0.10) == []
 
 
+def test_goodput_unit_gates_on_absolute_points_drop():
+    """goodput% (training goodput share, BENCH_train's
+    train_goodput_pct) is higher-is-better on ABSOLUTE points: a point
+    of wall-clock leaked into a badput bucket is the same loss whether
+    the baseline sat at 99 or at 60, so the near-100 healthy baseline
+    must trip on a drop the relative band would hide — and an
+    improvement never trips."""
+    old = [_m("train_goodput_pct", 92.0, "goodput%")]
+    ok = [_m("train_goodput_pct", 84.0, "goodput%")]     # -8 pts
+    bad = [_m("train_goodput_pct", 78.0, "goodput%")]    # -14 pts
+    assert check_bench.compare(old, ok, tolerance=0.10) == []
+    problems = check_bench.compare(old, bad, tolerance=0.10)
+    assert len(problems) == 1 and "-14.0 points" in problems[0]
+    up = [_m("train_goodput_pct", 99.0, "goodput%")]
+    assert check_bench.compare(old, up, tolerance=0.10) == []
+
+
 def test_recsys_examples_per_sec_is_rate_like():
     """examples/s (DLRM training/serving throughput) gates like
     tokens/s: relative, shrink = regression."""

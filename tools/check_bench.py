@@ -58,7 +58,12 @@ _ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%",
 # serve_spec_accept_pct): a drop means drafts stopped matching the
 # verifier and every verify dispatch degrades toward a plain decode
 # step — the same anywhere-in-0-100 shape as hit%, so absolute points.
-_ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%", "accept%"}
+# goodput% is the training goodput ledger's productive share
+# (BENCH_train, train_goodput_pct): a drop means wall-clock leaked into
+# a badput bucket — a point loss is a point loss whether the baseline
+# sat at 99 or at 60, so absolute points again.
+_ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%", "accept%",
+                           "goodput%"}
 # recsys rate-like units (BENCH_recsys) ride the default direction:
 # examples/s (training/serving throughput) and ratio (dedup ratio —
 # mean ids served per row fetched, >= 1) are higher-is-better relative,

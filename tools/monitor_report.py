@@ -46,6 +46,13 @@ stream — prints:
   burn rate per window (1.0 = spending exactly the budget; rendered
   next to --serve, which tells you *what* is failing while this tells
   you *how fast the budget goes*);
+- with ``--goodput``: the training goodput view — the
+  ``train_goodput_pct`` gauge, cumulative badput seconds by exclusive
+  bucket (``train_badput_seconds_total``), and the per-layer model
+  health table (``train_layer_{grad_norm,param_norm,update_ratio}``
+  gauges + ``train_health_spikes_total``) from the goodput ledger
+  (monitor/goodput.py; docs/OBSERVABILITY.md "Training goodput & model
+  health");
 - with ``--fallbacks``: every counted degradation in ONE table — scan
   loop-layout, Pallas-kernel XLA, pipeline sequential-GSPMD, MoE and
   recsys auto-path fallbacks with reason labels ("why is this run
@@ -75,7 +82,7 @@ tree with per-span duration, EXCLUSIVE time and the critical path
 (docs/OBSERVABILITY.md "Structured tracing").
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--fleet] [--slo] [--comms] [--moe] [--recsys] [--fallbacks]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--fleet] [--slo] [--goodput] [--comms] [--moe] [--recsys] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
@@ -171,6 +178,69 @@ def _comms_section(latest, used) -> List[str]:
     if not o_rows and not m_rows:
         out.append("(no comm-overlap or pipeline gauges in this dump — "
                    "run bench.py --multichip with FLAGS_monitor on)")
+        out.append("")
+    return out
+
+
+def _goodput_section(latest, used) -> List[str]:
+    """--goodput: training goodput ledger + per-layer model health.
+    Buckets are EXCLUSIVE and sum to trainer wall-clock (the ledger's
+    exhaustiveness invariant), so the badput table reads as a complete
+    where-did-the-time-go attribution, not a sample."""
+    out: List[str] = []
+    g_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        if name in ("train_goodput_pct", "train_step_mfu"):
+            used.add(key)
+            g_rows.append([name, _fmt_labels(labels),
+                           f"{latest[key].get('value', 0.0):,.2f}"])
+    out += _table("Training goodput (FLAGS_train_goodput)",
+                  ["metric", "labels", "value"], g_rows)
+    b_rows = []
+    for key, row in latest.items():
+        name, labels = key
+        if name != "train_badput_seconds_total":
+            continue
+        used.add(key)
+        b_rows.append([str(dict(labels).get("bucket", "?")),
+                       float(row.get("value", 0.0))])
+    b_rows.sort(key=lambda r: -r[1])
+    out += _table("Badput by bucket (exclusive, cumulative seconds)",
+                  ["bucket", "seconds"],
+                  [[b, f"{s:,.2f}"] for b, s in b_rows])
+    # per-layer health gauges fold into one row per layer, worst grad
+    # norm first — the monitor_top "top offenders" view, in full
+    per: Dict[str, dict] = {}
+    short = {"train_layer_grad_norm": "grad",
+             "train_layer_param_norm": "param",
+             "train_layer_update_ratio": "update"}
+    for key, row in latest.items():
+        name, labels = key
+        if name in short:
+            used.add(key)
+            layer = str(dict(labels).get("layer", "?"))
+            per.setdefault(layer, {})[short[name]] = \
+                float(row.get("value", 0.0))
+        elif name == "train_health_spikes_total":
+            used.add(key)
+            layer = str(dict(labels).get("layer", "?"))
+            per.setdefault(layer, {})["spikes"] = \
+                float(row.get("value", 0.0))
+    l_rows = [[layer, f"{d.get('grad', 0.0):,.4g}",
+               f"{d.get('param', 0.0):,.4g}",
+               f"{d.get('update', 0.0):,.2e}",
+               f"{d.get('spikes', 0.0):g}"]
+              for layer, d in sorted(per.items(),
+                                     key=lambda kv:
+                                     -kv[1].get("grad", 0.0))]
+    out += _table("Per-layer model health (FLAGS_train_health_every)",
+                  ["layer", "grad norm", "param norm", "update ratio",
+                   "spikes"], l_rows)
+    if not g_rows and not b_rows and not l_rows:
+        out.append("(no goodput series in this dump — train with "
+                   "FLAGS_train_goodput on; per-layer health additionally "
+                   "needs FLAGS_train_health_every=N)")
         out.append("")
     return out
 
@@ -739,7 +809,7 @@ _RECOVERY_EVENTS_FALLBACK = (
     "nonfinite_skip", "preempted", "trip", "chaos", "request_failed",
     "request_expired", "request_cancelled", "request_drained",
     "request_shed", "decode_watchdog", "overload", "drained",
-    "replica_migration")
+    "replica_migration", "health_spike")
 
 
 def _recovery_events() -> tuple:
@@ -784,6 +854,37 @@ def render_flight(doc: dict, last: int = 10) -> str:
     lines.append("fingerprint: " + (", ".join(
         f"{k}={fp[k]}" for k in sorted(fp) if k != "argv") or "(none)"))
     lines.append("")
+    # goodput dump provider (monitor/goodput.py): the ledger snapshot
+    # at trip time — how much of the run's wall-clock was productive
+    # when this dump fired, and where the rest went
+    gp = doc.get("goodput")
+    if isinstance(gp, dict):
+        lines.append(f"goodput: {float(gp.get('goodput_pct', 0)):,.1f}% "
+                     f"of {float(gp.get('elapsed_s', 0)):,.1f}s "
+                     f"productive ({int(gp.get('restarts', 0))} "
+                     "prior restarts)")
+        b_rows = [[b, f"{float(s):,.2f}"]
+                  for b, s in sorted((gp.get("buckets") or {}).items(),
+                                     key=lambda kv: -float(kv[1]))
+                  if float(s) > 0]
+        if b_rows:
+            lines.append("")
+            lines += _table("Goodput buckets at dump (seconds)",
+                            ["bucket", "seconds"], b_rows)
+    lh = doc.get("layer_health")
+    if isinstance(lh, dict) and lh.get("layers"):
+        h_rows = [[layer, f"{float(d.get('grad_norm', 0)):,.4g}",
+                   f"{float(d.get('param_norm', 0)):,.4g}",
+                   f"{float(d.get('update_ratio', 0)):,.2e}"]
+                  for layer, d in sorted(
+                      lh["layers"].items(),
+                      key=lambda kv:
+                      -float(kv[1].get("grad_norm", 0)))]
+        lines.append("")
+        lines += _table("Last layer-health vector "
+                        f"(step {lh.get('step', '?')})",
+                        ["layer", "grad norm", "param norm",
+                         "update ratio"], h_rows)
     ev = doc.get("events") or []
     lines += _recovery_section(ev)
     e_rows = [[str(r.get("event", "?")),
@@ -924,7 +1025,7 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
            moe: bool = False, fallbacks: bool = False,
            recsys: bool = False, slo: bool = False,
-           fleet: bool = False) -> str:
+           fleet: bool = False, goodput: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -938,6 +1039,9 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
                   if serve else [])
     # -- SLO burn (--slo) renders next to --serve ------------------------
     serve_out += _slo_section(latest, used) if slo else []
+    # -- training goodput (--goodput) claims the train_* ledger series
+    # before the generic counter tables ----------------------------------
+    serve_out += _goodput_section(latest, used) if goodput else []
     # -- comm overlap (--comms) also claims its gauges early -------------
     comms_out: List[str] = (_comms_section(latest, used) if comms else [])
     # -- MoE router health (--moe) renders next to --comms ---------------
@@ -1093,6 +1197,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo = "--slo" in argv
     if slo:
         argv.remove("--slo")
+    goodput = "--goodput" in argv
+    if goodput:
+        argv.remove("--goodput")
     fallbacks = "--fallbacks" in argv
     if fallbacks:
         argv.remove("--fallbacks")
@@ -1131,7 +1238,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
                  moe=moe, fallbacks=fallbacks, recsys=recsys, slo=slo,
-                 fleet=fleet),
+                 fleet=fleet, goodput=goodput),
           end="")
     return 0
 

@@ -194,6 +194,35 @@ def render_frame(ring, url: str, now: Optional[float] = None,
         lines.append("")
         lines.append("training  " + "   ".join(sorted(t_rows)))
 
+    # -- training goodput (FLAGS_train_goodput) -----------------------------
+    gp = ring.latest("train_goodput_pct")
+    if gp is not None:
+        lines.append("")
+        lines.append(f"goodput   {gp:5.1f}% productive")
+        bad_bits = []
+        for labels in ring.label_sets("train_badput_seconds_total"):
+            r = ring.rate("train_badput_seconds_total", W, **labels)
+            if r:
+                # seconds-per-second of badput: 0.25 = a quarter of
+                # wall-clock going to this bucket over the window
+                bad_bits.append(f"{labels.get('bucket', '?')} {r:,.2f}")
+        if bad_bits:
+            lines.append("badput/s  " + "   ".join(sorted(bad_bits)))
+        # top-offender layers by grad norm (FLAGS_train_health_every)
+        layer_rows = []
+        for labels in ring.label_sets("train_layer_grad_norm"):
+            v = ring.latest("train_layer_grad_norm", **labels)
+            if v is not None:
+                layer_rows.append((v, labels.get("layer", "?")))
+        if layer_rows:
+            layer_rows.sort(reverse=True)
+            cells = []
+            for v, layer in layer_rows[:4]:
+                u = ring.latest("train_layer_update_ratio", layer=layer)
+                cells.append(f"{layer} |g|={v:,.3g}"
+                             + (f" u={u:,.1e}" if u is not None else ""))
+            lines.append("layers    " + "   ".join(cells))
+
     if fleet:
         lines.extend(render_fleet_pane(ring))
 
