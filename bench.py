@@ -632,7 +632,9 @@ def bench_serve(quick: bool = False) -> list:
                                       quick=quick)
     mt_lines = serve_multitenant_metrics(model, name, serve_cfg,
                                          quick=quick)
-    return throughput_lines + fleet_lines + mt_lines + [
+    swap_lines = serve_lifecycle_metrics(model, name, serve_cfg,
+                                         quick=quick)
+    return throughput_lines + fleet_lines + mt_lines + swap_lines + [
         metric_line(f"serve_{name}_tokens_per_sec",
                     summary["tokens_per_sec"], "tokens/s",
                     vs_baseline=1.0,
@@ -941,6 +943,134 @@ def serve_fleet_metrics(model, name, serve_cfg, quick: bool) -> list:
         metric_line("serve_fleet_monitor_overhead_pct",
                     monitor_overhead, "overhead%", vs_baseline=1.0,
                     federated_tokens_per_sec=round(fed_tps, 1)),
+    ]
+
+
+def serve_lifecycle_metrics(model, name, serve_cfg, quick: bool) -> list:
+    """ISSUE 20 leg: the zero-downtime weight-push drill. A 2-replica
+    hot-swap-armed fleet serves the bursty ``mmpp`` arrival shape while
+    the live tree is re-pushed through
+    :meth:`~paddle_tpu.serving.ServingEngine.swap_weights` THREE times
+    (at the quarter points of the offered schedule, every replica each
+    time — the identity candidate makes greedy outputs swap-invariant,
+    so any lost token is the cutover's fault, not the weights').
+    Records ``serve_swap_availability_pct`` (swap%: absolute points,
+    higher-is-better in check_bench — it lives at ~100 where a relative
+    band would hide a 9-point outage) and REFUSES to record unless all
+    3 swaps cut over on every replica, availability held >= 99.9%, and
+    the request accounting closed exactly (offered == completed +
+    failed + rejected, zero in flight, zero duplicate ids — the
+    zero-lost/zero-dup contract from docs/SERVING.md "Model
+    lifecycle")."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from paddle_tpu.core.flags import flag_scope
+    from paddle_tpu.distributed import checkpoint as dckpt
+    from paddle_tpu.serving import (FleetRouter, LoadSpec, RouterConfig,
+                                    SamplingParams, ServerOverloaded,
+                                    ServingEngine, build_requests)
+
+    n_reps = 2
+    if quick:
+        rep_cfg = dataclasses.replace(serve_cfg)
+        spec = LoadSpec(num_requests=48, rate_rps=240.0,
+                        prompt_len_range=(4, 12), max_new_range=(6, 12),
+                        vocab_size=model.cfg.vocab_size, seed=17,
+                        sampling=SamplingParams(), arrival="mmpp",
+                        burstiness=3.0, mmpp_switch=0.2,
+                        shared_prefix_len=16, prefix_pool_size=4,
+                        prefix_zipf=1.05, tenants=8)
+    else:
+        rep_cfg = dataclasses.replace(serve_cfg, max_batch_slots=4,
+                                      max_context_len=256)
+        spec = LoadSpec(num_requests=48, rate_rps=24.0,
+                        prompt_len_range=(16, 64),
+                        max_new_range=(8, 24),
+                        vocab_size=model.cfg.vocab_size, seed=17,
+                        sampling=SamplingParams(), arrival="mmpp",
+                        burstiness=3.0, mmpp_switch=0.2,
+                        shared_prefix_len=64, prefix_pool_size=4,
+                        prefix_zipf=1.05, tenants=8)
+    with flag_scope("serve_hot_swap", True):
+        reps = {}
+        for i in range(n_reps):
+            eng = ServingEngine(model, dataclasses.replace(rep_cfg))
+            eng.warmup()
+            reps[f"r{i}"] = eng
+        router = FleetRouter(reps, RouterConfig(
+            seed=3, saturation_queue_depth=12))
+    push_dir = tempfile.mkdtemp(prefix="bench_swap_")
+    schedule = build_requests(spec)
+    quarters = [len(schedule) // 4, len(schedule) // 2,
+                (3 * len(schedule)) // 4]
+    swaps_done = 0
+    rejected = 0
+    try:
+        # the pushed candidate: the live tree itself, re-saved as a
+        # committed manifest checkpoint (identity swap — the strongest
+        # isolation of cutover mechanics from weight quality)
+        dckpt.save(dict(reps["r0"].params), push_dir,
+                   asynchronous=False)
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(schedule) or any(
+                r.alive and r.engine.scheduler.has_work
+                for r in router.replicas.values()):
+            now = time.perf_counter() - t0
+            while i < len(schedule) and schedule[i][0] <= now:
+                try:
+                    router.submit(schedule[i][1])
+                except ServerOverloaded:
+                    rejected += 1
+                i += 1
+            if swaps_done < len(quarters) and i >= quarters[swaps_done]:
+                # live push: every replica, no drain, traffic running
+                for rep in router.replicas.values():
+                    info = rep.engine.swap_weights(push_dir)
+                    if not info.get("pending"):
+                        rep.engine.commit_swap()
+                swaps_done += 1
+            if not router.step_all() and i < len(schedule):
+                wait = schedule[i][0] - (time.perf_counter() - t0)
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+        epochs = {n: r.engine.metrics_summary()["weights_epoch"]
+                  for n, r in router.replicas.items()}
+        summary = router.summary()
+    finally:
+        router.shutdown()
+        shutil.rmtree(push_dir, ignore_errors=True)
+    avail = summary["availability_pct"]
+    lost = (summary["requests_offered"] - summary["requests_completed"]
+            - summary["requests_failed"] - summary["requests_rejected"])
+    problems = []
+    if any(e != len(quarters) for e in epochs.values()):
+        problems.append(f"epochs {epochs} != {len(quarters)} everywhere")
+    if avail < 99.9:
+        problems.append(f"availability {avail:.2f}% < 99.9%")
+    if lost or summary["requests_in_flight"]:
+        problems.append(f"{lost} lost / "
+                        f"{summary['requests_in_flight']} in flight")
+    if summary["duplicate_request_ids"]:
+        problems.append(f"{summary['duplicate_request_ids']} duplicate "
+                        "request ids")
+    if problems:
+        log(f"serve[lifecycle]: SWAP DRILL FAILURE — {'; '.join(problems)}"
+            "; refusing to record the hot-swap leg")
+        return []
+    log(f"serve[lifecycle/{name}]: {swaps_done} live swaps x {n_reps} "
+        f"replicas under mmpp load: availability {avail:.2f}%, "
+        f"{summary['requests_completed']} completed / "
+        f"{summary['requests_failed']} failed / {rejected} rejected, "
+        f"accounting closed (0 lost, 0 dup), final epochs {epochs}")
+    return [
+        # swap% gates on ABSOLUTE points, drop = regression
+        # (check_bench _ABS_POINT_HIGHER_UNITS)
+        metric_line("serve_swap_availability_pct", avail, "swap%",
+                    vs_baseline=1.0, swaps=swaps_done,
+                    replicas=n_reps),
     ]
 
 

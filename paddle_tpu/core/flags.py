@@ -468,6 +468,41 @@ define_flag("train_health_every", 0,
             "attaches the last vector to flight-recorder dumps. "
             "0 (default) = OFF: the step program is bit-identical and "
             "nothing is computed or published.")
+define_flag("serve_hot_swap", False,
+            "Zero-downtime model lifecycle (serving/engine.py, ISSUE "
+            "20): arm ServingEngine.swap_weights — load + verify a "
+            "candidate manifest checkpoint, stage the new param tree "
+            "beside the live one and cut over atomically at the next "
+            "iteration boundary, in-flight slots finishing on the "
+            "weights they started on (per-slot generation epoch; "
+            "drain-and-restore fallback when HBM headroom can't hold "
+            "two trees). Off (default) = swap_weights raises, no epoch "
+            "bookkeeping exists, dispatch traffic is byte-identical to "
+            "pre-lifecycle engines (pinned). Read once at engine "
+            "construction.")
+define_flag("serve_traffic_split", False,
+            "Shadow/A-B traffic splitting (serving/router.py, ISSUE "
+            "20): arm FleetRouter.set_traffic_split — a TrafficSplit "
+            "policy hash-splits a deterministic fraction of requests "
+            "onto a candidate replica (A/B) and/or mirrors a fraction "
+            "as shadow copies (responses discarded but fully "
+            "measured), with per-arm request counters, latency "
+            "histograms and greedy-divergence counters. Off (default) "
+            "= set_traffic_split raises, zero per-request overhead and "
+            "zero new registry series (pinned). Read once at router "
+            "construction.")
+define_flag("serve_lifecycle", False,
+            "SLO-guarded promotion controller (serving/lifecycle.py, "
+            "ISSUE 20): arm LifecycleController — stage a candidate "
+            "manifest on one replica, bake it under a traffic split "
+            "while an SLOTracker watches the candidate arm's "
+            "availability burn / non-finite rate / greedy divergence, "
+            "then either promote (rolling swap, never two replicas "
+            "down at once) or auto-roll-back to the previous weights, "
+            "emitting flight events and an incident bundle on "
+            "rollback. Off (default) = the controller refuses to "
+            "construct; nothing else changes. Read once at controller "
+            "construction.")
 define_flag("compilation_cache", True,
             "Persist compiled XLA executables to disk so warm starts skip "
             "the 20-40s first-compile (reference analogue: the CUDA "

@@ -21,14 +21,21 @@ literature mapped onto static-shape XLA programs:
   ``bench.py --serve`` (the ``BENCH_serve`` record);
 - :mod:`.router` scales one engine to a fleet (ISSUE 16): a
   prefix-affine front-end over N replicas with telemetry-driven load
-  balancing and chaos-proof drain/death migration.
+  balancing and chaos-proof drain/death migration;
+- :mod:`.lifecycle` pushes new weights through that fleet with zero
+  downtime (ISSUE 20): live hot-swap with per-slot weight epochs
+  (:meth:`~.engine.ServingEngine.swap_weights`), shadow/A-B traffic
+  splitting, and an SLO-guarded promote-or-rollback controller.
 
 See docs/SERVING.md for architecture, bucketing policy, the flag
 matrix and the fleet topology.
 """
 
 from .detok import StreamingDetokenizer  # noqa: F401
-from .engine import ServingConfig, ServingEngine  # noqa: F401
+from .engine import (ServingConfig, ServingEngine,  # noqa: F401
+                     WeightSwapError)
+from .lifecycle import (LifecycleConfig, LifecycleController,  # noqa: F401
+                        TrafficSplit, assign_arm, should_shadow)
 from .kv_cache import (BlockAllocator, ContextPagedCacheView,  # noqa: F401
                        ContextPagedLayerCache, PagedCacheView,
                        PagedKVCache, PagedLayerCache)
@@ -58,6 +65,8 @@ __all__ = [
     "RadixPrefixCache", "propose_ngram", "ContextPagedCacheView",
     "ContextPagedLayerCache", "FleetRouter", "ReplicaHandle",
     "RouterConfig", "run_fleet_open_loop", "filtered_logits",
+    "WeightSwapError", "TrafficSplit", "LifecycleConfig",
+    "LifecycleController", "assign_arm", "should_shadow",
 ]
 
 

@@ -56,13 +56,26 @@ from .kv_cache import (ContextPagedCacheView, PagedCacheView,
 from .resilience import (DecodeWatchdogError, DispatchWorker, DrainLatch,
                          DrainReport, EngineDrained, OverloadDetector,
                          ServerOverloaded, request_spec,
-                         save_drain_snapshot)
+                         requests_from_snapshot, save_drain_snapshot)
 from .sampling import (SamplingParams, _NEG as _SAMPLING_NEG,
                        filtered_logits, sample_tokens)
 from .scheduler import (QUEUE_POLICIES, AdmissionGroup, BucketTable,
                         Request, RequestState, Scheduler)
 
-__all__ = ["ServingConfig", "ServingEngine"]
+__all__ = ["ServingConfig", "ServingEngine", "WeightSwapError"]
+
+
+class WeightSwapError(RuntimeError):
+    """A candidate weight push was refused (torn manifest, param-tree
+    mismatch, unreadable checkpoint) or a rollback had nothing retained
+    to roll back to. Refusal is side-effect free: the serving weights
+    did not change and traffic keeps flowing on the old tree."""
+
+    def __init__(self, manifest_dir: str, reason: str):
+        super().__init__(
+            f"weight swap refused for {manifest_dir!r}: {reason}")
+        self.manifest_dir = manifest_dir
+        self.reason = reason
 
 #: live engines, for test isolation (serving.reset shuts them down)
 _LIVE_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
@@ -297,6 +310,28 @@ class ServingEngine:
         #: last watchdog trip (kind/timeout/dispatch) — readiness
         #: reports it until a later guarded dispatch succeeds
         self._watchdog_tripped: Optional[dict] = None
+        # model lifecycle (ISSUE 20): live weight hot-swap. Flag read
+        # once, same contract as the throughput features above — off ⇒
+        # swap_weights raises, _retired stays empty forever and every
+        # dispatch takes the single-epoch path (byte-identical to the
+        # pre-lifecycle engine).
+        self._hot_swap = bool(get_flag("serve_hot_swap"))
+        #: live weights generation; bumped at every cutover (including
+        #: rollback cutovers). Slots are stamped with the epoch whose
+        #: tree wrote their first KV page and finish on that tree.
+        self._weights_epoch = 0
+        #: candidate staged for the next iteration-boundary cutover
+        self._staged: Optional[dict] = None
+        #: epoch -> param tree still referenced by in-flight slots
+        self._retired: Dict[int, dict] = {}
+        #: pre-swap tree retained as the rollback anchor until
+        #: commit_swap() drops it (promotion) or rollback_weights()
+        #: re-stages it
+        self._previous: Optional[dict] = None
+        self._live_manifest: Optional[str] = None
+        self._swap_stats = {"staged": 0, "cutover": 0, "refused": 0,
+                            "rolled_back": 0, "committed": 0,
+                            "drain_swaps": 0}
         _LIVE_ENGINES.add(self)
         self._attach_admin()
 
@@ -370,6 +405,16 @@ class ServingEngine:
                            if self._overload is not None else False),
             "watchdog_tripped": self._watchdog_tripped,
         }
+        if self._hot_swap:
+            d["weights"] = {
+                "epoch": self._weights_epoch,
+                "live_manifest": self._live_manifest,
+                "staged": (self._staged["manifest"]
+                           if self._staged is not None else None),
+                "retired_epochs": sorted(self._retired),
+                "rollback_available": self._previous is not None,
+                "swaps": dict(self._swap_stats),
+            }
         if self.lora is not None:
             d["lora"] = {
                 "loaded": self.lora.loaded(),
@@ -1019,6 +1064,304 @@ class ServingEngine:
         return DrainReport(completed=completed, snapshotted=len(specs),
                            path=path)
 
+    # -- live weight hot-swap (ISSUE 20) -------------------------------------
+    def _swaps_counter(self):
+        return get_registry().counter(
+            "serve_swaps_total",
+            "weight hot-swap lifecycle events (staged/cutover/refused/"
+            "rolled_back/committed/drain_fallback)")
+
+    def swap_weights(self, manifest_dir: str, mode: str = "auto") -> dict:
+        """Load + verify a candidate checkpoint and swap it in WITHOUT
+        dropping traffic (ISSUE 20).
+
+        The candidate must be a committed manifest checkpoint of this
+        engine's exact param tree (names/shapes/dtypes). A torn or
+        mismatched push REFUSES (:class:`WeightSwapError`) with no side
+        effects — the old weights keep serving. A valid push is staged
+        beside the live tree and cut over atomically at the next
+        iteration boundary (immediately when nothing is in flight);
+        in-flight slots finish on the weights that wrote their KV pages
+        (per-slot generation epoch — the LoRA pool-row convention
+        generalized to the dense tree). When device memory can't hold
+        two trees (``monitor.memory`` preflight), falls back to
+        drain-and-restore through the PR 8 snapshot machinery: the tree
+        swaps with nothing in flight and every unfinished continuation
+        resubmits with its client callbacks re-attached.
+
+        ``mode``: ``"auto"`` (preflight chooses) | ``"staged"`` |
+        ``"drain"``. Returns a dict with ``mode``/``epoch`` plus
+        per-mode detail. Weight swap never skips checkpoint
+        verification: ``FLAGS_checkpoint_verify`` escalates the level
+        but ``off`` does not disarm it."""
+        if not self._hot_swap:
+            raise RuntimeError(
+                "FLAGS_serve_hot_swap is off — live weight swap is "
+                "disarmed for this engine (the flag is read once at "
+                "construction)")
+        if mode not in ("auto", "staged", "drain"):
+            raise ValueError(
+                f"swap mode {mode!r}: expected auto|staged|drain")
+        from ..core.flags import get_flag
+        from ..distributed import checkpoint as ckpt
+        state = None
+        if chaos.active() and chaos.probe("serve.swap.torn_manifest"):
+            reason = ("chaos serve.swap.torn_manifest: candidate "
+                      "manifest torn mid-push")
+        else:
+            level = get_flag("checkpoint_verify")
+            reason = ckpt.verify_checkpoint(
+                manifest_dir,
+                level="manifest" if level == "off" else level)
+        if reason is None:
+            try:
+                state = ckpt.load(manifest_dir)
+            except Exception as e:
+                reason = f"load failed ({type(e).__name__}: {e})"
+        if reason is None:
+            reason = self._validate_candidate(state)
+        if reason is not None:
+            # refusal is side-effect free: old weights keep serving
+            self._swap_stats["refused"] += 1
+            self._swaps_counter().inc(event="refused")
+            self._flight_event("swap_refused", manifest=manifest_dir,
+                               reason=reason)
+            raise WeightSwapError(manifest_dir, reason)
+        # place each candidate leaf exactly like its live counterpart —
+        # the compiled programs' input shardings must match untouched
+        tree = {name: jax.device_put(jnp.asarray(state[name]),
+                                     live.sharding)
+                for name, live in self.params.items()}
+        if chaos.active() and chaos.probe("serve.swap.bad_weights"):
+            # corruption that SURVIVES manifest verification: plant NaN
+            # into the first floating leaf. The swap path deliberately
+            # does not scan finiteness (a full-tree reduction per push);
+            # the damage manifests as non-finite logits in flight — the
+            # signal the lifecycle controller's auto-rollback drills on.
+            for name, leaf in tree.items():
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    tree[name] = jnp.full_like(leaf, float("nan"))
+                    break
+        if mode == "auto":
+            mode = "staged" if self._swap_headroom_ok(tree) else "drain"
+        if mode == "drain":
+            return self._swap_via_drain(tree, manifest_dir)
+        return self._stage(tree, manifest_dir)
+
+    def _validate_candidate(self, state) -> Optional[str]:
+        """None when ``state`` is exactly this model's param tree
+        (names/shapes/dtypes), else the human-readable refusal reason."""
+        if not isinstance(state, dict):
+            return ("candidate is not a param dict "
+                    f"({type(state).__name__})")
+        live, cand = set(self.params), set(state)
+        if live != cand:
+            missing = sorted(live - cand)[:3]
+            extra = sorted(cand - live)[:3]
+            return ("param tree mismatch"
+                    + (f"; missing {missing}" if missing else "")
+                    + (f"; unexpected {extra}" if extra else ""))
+        for name, ref in self.params.items():
+            arr = state[name]
+            if tuple(arr.shape) != tuple(ref.shape):
+                return (f"shape mismatch at {name}: candidate "
+                        f"{tuple(arr.shape)} vs serving "
+                        f"{tuple(ref.shape)}")
+            if jnp.dtype(arr.dtype) != jnp.dtype(ref.dtype):
+                return (f"dtype mismatch at {name}: candidate "
+                        f"{jnp.dtype(arr.dtype).name} vs serving "
+                        f"{jnp.dtype(ref.dtype).name}")
+        return None
+
+    def _swap_headroom_ok(self, tree: dict) -> bool:
+        """``monitor.memory`` preflight for the staged (dual-tree) swap:
+        True when the device reports room for the candidate's bytes
+        with a 25% safety margin (conservative: compares the WHOLE
+        tree's bytes against one device's headroom, so sharded trees
+        pass early). Backends that publish no allocator stats (the CPU
+        test backend) stage — the host heap is the constraint there,
+        not HBM."""
+        from ..monitor import memory as _memory
+        stats = _memory.device_memory_stats()
+        if not stats:
+            return True
+        limit = stats.get("bytes_limit") \
+            or stats.get("bytes_reservable_limit")
+        if not limit:
+            return True
+        need = sum(int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+                   for a in tree.values())
+        free = int(limit) - int(stats.get("bytes_in_use", 0))
+        return free >= need * 1.25
+
+    def _stage(self, tree: dict, manifest_dir: Optional[str]) -> dict:
+        self._staged = {"params": tree, "manifest": manifest_dir}
+        self._swap_stats["staged"] += 1
+        self._swaps_counter().inc(event="staged")
+        self._flight_event("weights_staged", manifest=manifest_dir,
+                           epoch=self._weights_epoch + 1)
+        if not self.scheduler.active():
+            # nothing in flight: between steps IS an iteration boundary
+            self._cutover()
+        return {"mode": "staged", "epoch": self._weights_epoch,
+                "pending": self._staged is not None}
+
+    def _swap_via_drain(self, tree: dict,
+                        manifest_dir: Optional[str]) -> dict:
+        """HBM-constrained fallback: snapshot in-flight work as drain
+        specs, release the slots (nothing references the old tree any
+        more), cut over, then resubmit every unfinished continuation
+        with its client callbacks re-attached — tokens already streamed
+        stand, the continuation decodes on the new weights. Accounting
+        records the interrupted residencies ``drained`` and the
+        continuations as fresh submits, so the terminal-outcome
+        identity still balances. The waiting queue is untouched: queued
+        work never touched the old weights."""
+        sched = self.scheduler
+        sched.sweep_active()
+        inflight = [(st, request_spec(st)) for _, st in sched.active()]
+        for _, st in list(sched.active()):
+            sched.drain_release(st)
+        self._swap_stats["drain_swaps"] += 1
+        self._swaps_counter().inc(event="drain_fallback")
+        self._stage(tree, manifest_dir)   # no actives left: cuts over
+        resubmitted = []
+        for st_old, spec in inflight:
+            reqs = requests_from_snapshot([spec])
+            if not reqs:
+                continue                  # already had its full budget
+            req = reqs[0]
+            req.on_token = st_old.request.on_token
+            req.stop = st_old.request.stop
+            resubmitted.append(self.submit(req))
+        self._flight_event("weights_drain_swap",
+                           resubmitted=len(resubmitted),
+                           manifest=manifest_dir)
+        return {"mode": "drain", "epoch": self._weights_epoch,
+                "resubmitted": len(resubmitted),
+                "states": resubmitted}
+
+    def _cutover(self) -> None:
+        """The atomic swap point (top of :meth:`step`, or immediately
+        when idle): the staged tree becomes the live one. Slots in
+        flight keep a reference to the tree that wrote their KV pages
+        (``_retired``) until they terminate; the radix prefix tree is
+        flushed — and donation detached for the transition — because
+        cached pages carry the OLD weights' KV and must never seed a
+        new-epoch admission."""
+        staged, self._staged = self._staged, None
+        old_epoch = self._weights_epoch
+        actives = [st for _, st in self.scheduler.active()]
+        for st in actives:
+            if st.weights_epoch is None:
+                # admitted before the boundary (possibly prefix-seeded
+                # from old-weight pages): it belongs to the old epoch
+                st.weights_epoch = old_epoch
+        if actives:
+            self._retired[old_epoch] = self.params
+        self._previous = {"params": self.params,
+                          "manifest": self._live_manifest}
+        self._weights_epoch = old_epoch + 1
+        self.params = staged["params"]
+        self._live_manifest = staged["manifest"]
+        if self.prefix_cache is not None:
+            # safe with live shared-page references: the allocator is
+            # refcounted, clear() just drops the tree's own refs
+            self.prefix_cache.clear()
+            if actives:
+                # terminating old-epoch slots would DONATE old-weight
+                # pages into the fresh tree: detach until they're gone
+                # (free_slot skips donation while cache.prefix_cache
+                # is None); _retire_unreferenced re-attaches
+                self.cache.prefix_cache = None
+        self._swap_stats["cutover"] += 1
+        self._swaps_counter().inc(event="cutover")
+        get_registry().gauge(
+            "serve_weights_epoch",
+            "live weights generation (increments at every hot-swap "
+            "cutover, including rollback cutovers)").set(
+                float(self._weights_epoch))
+        self._flight_event("weights_cutover",
+                           epoch=self._weights_epoch,
+                           manifest=staged["manifest"],
+                           in_flight_old_epoch=len(actives))
+
+    def rollback_weights(self) -> dict:
+        """Swap BACK to the pre-swap weights (the auto-rollback path).
+        The previous tree is kept resident from cutover until
+        :meth:`commit_swap`, so rollback needs no reload — it stages
+        the retained tree and cuts over at the next iteration boundary
+        (immediately when idle). After the rollback cutover the BAD
+        tree becomes the retained previous; ``commit_swap()`` then
+        drops it."""
+        if not self._hot_swap:
+            raise RuntimeError(
+                "FLAGS_serve_hot_swap is off — rollback_weights is "
+                "disarmed for this engine")
+        prev = self._previous
+        if prev is None:
+            raise WeightSwapError(
+                "<previous>", "no previous weights retained (already "
+                "committed, or never swapped)")
+        self._previous = None
+        self._swap_stats["rolled_back"] += 1
+        self._swaps_counter().inc(event="rolled_back")
+        self._flight_event("weights_rolled_back",
+                           from_epoch=self._weights_epoch,
+                           to_manifest=prev["manifest"])
+        return self._stage(prev["params"], prev["manifest"])
+
+    def commit_swap(self) -> None:
+        """Promotion: drop the retained pre-swap tree (the rollback
+        anchor), freeing its memory. ``rollback_weights`` afterwards
+        raises — the lifecycle controller calls this once the bake
+        window passes (or after a rollback cutover, to drop the bad
+        tree)."""
+        if self._previous is not None:
+            self._previous = None
+            self._swap_stats["committed"] += 1
+            self._swaps_counter().inc(event="committed")
+            self._flight_event("weights_committed",
+                               epoch=self._weights_epoch)
+
+    def _params_for(self, epoch: Optional[int]):
+        """The param tree for a slot epoch: the live tree for the live
+        epoch (and for unstamped slots), a retired tree during a swap
+        transition."""
+        if epoch is None or epoch == self._weights_epoch:
+            return self.params
+        return self._retired[epoch]
+
+    def _epoch_batches(self, pairs):
+        """Partition this iteration's decodable slots into one
+        (param_tree, pairs) dispatch batch per weights epoch. Outside a
+        swap transition — the steady state, and always when
+        ``FLAGS_serve_hot_swap`` is off — ``_retired`` is empty and
+        this is ONE batch with the live tree: dispatch count and
+        arguments identical to the pre-lifecycle engine (the flags-off
+        pin)."""
+        if not self._retired:
+            return [(self.params, pairs)] if pairs else []
+        by_epoch: Dict[int, list] = {}
+        for slot, st in pairs:
+            e = st.weights_epoch
+            e = self._weights_epoch if e is None else e
+            by_epoch.setdefault(e, []).append((slot, st))
+        return [(self._params_for(e), by_epoch[e])
+                for e in sorted(by_epoch)]
+
+    def _retire_unreferenced(self) -> None:
+        """Free retired trees no in-flight slot references any more;
+        when the last one goes, the swap transition is over and prefix
+        donation re-attaches (onto the flushed, new-epoch-only tree)."""
+        live = {st.weights_epoch for _, st in self.scheduler.active()}
+        for e in [e for e in self._retired if e not in live]:
+            del self._retired[e]
+            self._flight_event("weights_retired", epoch=e)
+        if not self._retired and self.prefix_cache is not None \
+                and self.cache.prefix_cache is None:
+            self.cache.prefix_cache = self.prefix_cache
+
     # -- the serving iteration ----------------------------------------------
     def step(self, admit: bool = True) -> bool:
         """One scheduler iteration: honour drain/cancel/deadlines at the
@@ -1028,6 +1371,10 @@ class ServingEngine:
         if self._drain_latch is not None and self._drain_latch.triggered \
                 and not self._draining:
             raise EngineDrained(self.drain())
+        if self._staged is not None:
+            # the atomic cutover point: an iteration boundary, before
+            # any admission/prefill/decode of this step
+            self._cutover()
         sched = self.scheduler
         # iteration-boundary sweeps: queued expiries never touch a slot;
         # latched cancels / in-flight expiries free pages immediately.
@@ -1078,11 +1425,15 @@ class ServingEngine:
                 # recompute-preemption: back to the queue with the SAME
                 # trace — the span tree shows the second residency
                 self._trace_requeue(st, "preemption")
-            if self._decodable():
-                if any(st.draft for _, st in sched.active()):
-                    self._run_verify()
+            # one decode/verify dispatch per live weights epoch: a
+            # single batch (the live tree) outside a swap transition
+            for params, pairs in self._epoch_batches(self._decodable()):
+                if any(st.draft for _, st in pairs):
+                    self._run_verify(pairs, params)
                 else:
-                    self._run_decode()
+                    self._run_decode(pairs, params)
+        if self._retired:
+            self._retire_unreferenced()
         self._publish_gauges()
         return sched.has_work
 
@@ -1229,18 +1580,25 @@ class ServingEngine:
         by (needs-context, length bucket) because a chunk at pos > 0
         must run the context program while pos == 0 chunks keep the
         bit-compatible plain one."""
-        by_key: Dict[Tuple[bool, int], List[RequestState]] = {}
+        by_key: Dict[Tuple[int, bool, int], List[RequestState]] = {}
         for _, st in self.scheduler.active():
             if not st.prefilling:
                 continue
             remaining = st.prefill_len - st.prefill_pos
             clen = min(self._chunk, remaining) if self._chunk > 0 \
                 else remaining
-            key = (st.prefill_pos > 0, self.buckets.len_bucket(clen))
+            # keyed by weights epoch too (ISSUE 20): a mid-chunk prefill
+            # carried across a cutover must keep its own tree, so it
+            # can't share a dispatch with new-epoch admissions. The
+            # epoch is constant outside a swap transition — identical
+            # grouping and ordering to the pre-lifecycle planner.
+            ep = st.weights_epoch
+            key = (self._weights_epoch if ep is None else ep,
+                   st.prefill_pos > 0, self.buckets.len_bucket(clen))
             by_key.setdefault(key, []).append(st)
         groups: List[AdmissionGroup] = []
-        for ctx, lb in sorted(by_key):
-            sts = sorted(by_key[(ctx, lb)],
+        for ep, ctx, lb in sorted(by_key):
+            sts = sorted(by_key[(ep, ctx, lb)],
                          key=lambda s: (s.admitted_t,
                                         s.request.request_id))
             mb = self.buckets.max_batch
@@ -1284,6 +1642,14 @@ class ServingEngine:
         t0 = self.clock()
         if self._t_first_work is None:
             self._t_first_work = t0
+        # stamp each residency's weights epoch at its FIRST chunk: the
+        # KV this dispatch writes belongs to that tree, and every later
+        # chunk/decode of the residency must keep using it across a hot
+        # swap (groups are epoch-homogeneous by construction)
+        for st in group.states:
+            if st.weights_epoch is None:
+                st.weights_epoch = self._weights_epoch
+        params = self._params_for(group.states[0].weights_epoch)
         for st in group.states:
             tr = st.trace
             if tr is not None and "admitted" not in st.trace_spans:
@@ -1299,13 +1665,13 @@ class ServingEngine:
                     prefix_hit_tokens=st.prefill_pos)
         if ctx:
             prog = self._get_prefill_ctx(nb, sp)
-            args = (self.params, self.cache.k, self.cache.v,
+            args = (params, self.cache.k, self.cache.v,
                     self.cache.table_array(rows), jnp.asarray(ids),
                     jnp.asarray(lens), jnp.asarray(pos),
                     self._next_key())
         else:
             prog = self._get_prefill(nb, sp)
-            args = (self.params, self.cache.k, self.cache.v,
+            args = (params, self.cache.k, self.cache.v,
                     self.cache.table_array(rows), jnp.asarray(ids),
                     jnp.asarray(lens), self._next_key())
         temps, tks, tps = self._sampling_arrays(states)
@@ -1397,10 +1763,11 @@ class ServingEngine:
                 "serve_spec_proposed_total",
                 "speculative draft tokens proposed").inc(proposed)
 
-    def _run_verify(self) -> None:
-        """ONE batched verify dispatch over all decodable slots: row 0
-        is each slot's plain decode step; rows 1..k score the staged
-        drafts. The accepted prefix plus one bonus token commit
+    def _run_verify(self, pairs, params) -> None:
+        """ONE batched verify dispatch over the given decodable slots
+        (one epoch's worth — all of them outside a swap transition):
+        row 0 is each slot's plain decode step; rows 1..k score the
+        staged drafts. The accepted prefix plus one bonus token commit
         (greedy-exact vs the non-speculative path); the rejected tail's
         pages roll back by block-table truncation."""
         B = self.config.max_batch_slots
@@ -1409,7 +1776,7 @@ class ServingEngine:
         ids = np.zeros((B, S), np.int32)
         active = np.zeros((B,), bool)
         per_slot: List[Optional[RequestState]] = [None] * B
-        for slot, st in self._decodable():
+        for slot, st in pairs:
             pos[slot] = st.seq_len - 1
             ids[slot, 0] = st.generated[-1]
             n = len(st.draft)
@@ -1425,7 +1792,7 @@ class ServingEngine:
         tok0, greedy, ok_rows, p_draft, tok_full, tok_resid, new_k, \
             new_v = self._guarded_dispatch(
                 "verify", prog,
-                (self.params, self.cache.k, self.cache.v,
+                (params, self.cache.k, self.cache.v,
                  self._decode_table(per_slot), jnp.asarray(pos),
                  jnp.asarray(ids), jnp.asarray(active), self._next_key(),
                  temps, tks, tps, self._poison_array(per_slot))
@@ -1531,13 +1898,13 @@ class ServingEngine:
                         "back by block-table truncation").inc(
                 rolled_back)
 
-    def _run_decode(self) -> None:
+    def _run_decode(self, pairs, params) -> None:
         B = self.config.max_batch_slots
         pos = np.zeros((B,), np.int32)
         tokens = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
         per_slot: List[Optional[RequestState]] = [None] * B
-        for slot, st in self._decodable():
+        for slot, st in pairs:
             # the newest generated token is not yet in the cache: this
             # step writes its K/V at position seq_len-1 and attends over
             # everything up to and including it
@@ -1552,7 +1919,7 @@ class ServingEngine:
         hang = chaos.active() and chaos.probe("serve.decode.hang")
         toks, ok, new_k, new_v = self._guarded_dispatch(
             "decode", prog,
-            (self.params, self.cache.k, self.cache.v,
+            (params, self.cache.k, self.cache.v,
              self._decode_table(per_slot), jnp.asarray(pos),
              jnp.asarray(tokens), jnp.asarray(active), self._next_key(),
              temps, tks, tps, self._poison_array(per_slot))
@@ -1574,7 +1941,7 @@ class ServingEngine:
         reg.histogram("serve_decode_occupancy",
                       "active slots per decode dispatch",
                       buckets=tuple(range(1, B + 1))).observe(n_active)
-        for slot, st in list(self._decodable()):
+        for slot, st in list(pairs):
             tr = st.trace
             if tr is not None:
                 # decode[i]: this request's share of the batched decode
@@ -1805,6 +2172,11 @@ class ServingEngine:
             "lora_swaps": (self.lora.swaps
                            if self.lora is not None else 0),
             "quota_deferred": sstats.get("quota_deferred", 0),
+            # model lifecycle (ISSUE 20)
+            "weights_epoch": self._weights_epoch,
+            "weight_swaps": self._swap_stats["cutover"],
+            "weight_swaps_refused": self._swap_stats["refused"],
+            "weight_swap_rollbacks": self._swap_stats["rolled_back"],
         }
 
     def shutdown(self) -> None:
@@ -1838,4 +2210,11 @@ class ServingEngine:
             self.prefix_cache.clear()
             self.cache.prefix_cache = None
             self.prefix_cache = None
+        # unstage any half-loaded candidate tree and drop retained /
+        # retired trees, clearing the epoch latch (ISSUE 20 fix): an
+        # aborted swap must not leak a full param tree of device memory
+        # into the next engine constructed in this process
+        self._staged = None
+        self._retired.clear()
+        self._previous = None
         self.cache.k = self.cache.v = None

@@ -108,6 +108,22 @@ class LoadSpec:
     #: from ``bench.py --serve``. Requires ``tenants > 0``. 0 (default)
     #: = no adapter/tenant stamping, byte-identical to pre-LoRA specs.
     adapter_pool: int = 0
+    #: model-lifecycle traffic tagging (ISSUE 20): > 0 = stamp each
+    #: request with the A/B arm (``lifecycle_arm``) a router running
+    #: ``TrafficSplit(ab_frac=ab_split, seed=split_seed)`` would place
+    #: it in — the SAME ``lifecycle.assign_arm`` hash of the request
+    #: id, no RNG draws, so arming it perturbs nothing about the
+    #: default draws (arrivals/prompts/lengths replay exactly; pinned).
+    #: 0.0 (default) = no stamping, byte-identical to pre-lifecycle
+    #: specs.
+    ab_split: float = 0.0
+    #: > 0 = stamp ``lifecycle_shadow=True`` on the requests a
+    #: ``TrafficSplit(shadow_frac=...)`` router would mirror (same
+    #: deterministic ``lifecycle.should_shadow`` hash); 0.0 (default)
+    #: = no stamping
+    shadow_frac: float = 0.0
+    #: seed the tags hash with (matches ``TrafficSplit.seed``)
+    split_seed: int = 0
 
 
 class TokenBucket:
@@ -247,12 +263,22 @@ def build_requests(spec: LoadSpec) -> List[Tuple[float, Request]]:
         if spec.priority_choices:
             priority = int(spec.priority_choices[
                 int(rng.integers(0, len(spec.priority_choices)))])
-        out.append((float(arrivals[i]), Request(
+        req = Request(
             prompt,
             max_new_tokens=int(rng.integers(lo_n, hi_n + 1)),
             sampling=spec.sampling or SamplingParams(),
             deadline_s=deadline, priority=priority,
-            tenant=tenant, adapter=adapter)))
+            tenant=tenant, adapter=adapter)
+        if spec.ab_split > 0.0 or spec.shadow_frac > 0.0:
+            # pure request-id hashes (lifecycle.assign_arm /
+            # should_shadow) — zero draws from ``rng``, so the tags
+            # ride along without perturbing any default field (pinned)
+            from .lifecycle import assign_arm, should_shadow
+            req.lifecycle_arm = assign_arm(
+                int(req.request_id), spec.split_seed, spec.ab_split)
+            req.lifecycle_shadow = should_shadow(
+                int(req.request_id), spec.split_seed, spec.shadow_frac)
+        out.append((float(arrivals[i]), req))
     return out
 
 

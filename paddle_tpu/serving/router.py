@@ -152,6 +152,14 @@ class _RouterRecord:
     done: bool = False
     outcome: Optional[str] = None
     state: object = None                # live RequestState, if any
+    #: split-arm tag while a TrafficSplit is armed (ISSUE 20):
+    #: "baseline" | "candidate" | "shadow"; None outside a bake —
+    #: records without an arm emit no arm metrics (flags-off pin)
+    arm: Optional[str] = None
+    t_submit: float = 0.0
+    #: for shadow mirrors: the primary record's request_id (greedy
+    #: divergence compares the two token streams)
+    shadow_of: Optional[int] = None
 
 
 class FleetRouter:
@@ -198,7 +206,19 @@ class FleetRouter:
         self._route_lat: List[float] = []
         self._stats = {"routed_affine": 0, "routed_balanced": 0,
                        "rejected": 0, "migrated_drain": 0,
-                       "migrated_death": 0, "migration_failed": 0}
+                       "migrated_death": 0, "migration_failed": 0,
+                       "shadow_mirrored": 0, "shadow_divergence": 0}
+        # shadow/A-B traffic splitting (ISSUE 20): flag read once — off
+        # ⇒ set_traffic_split raises, _split stays None forever and
+        # submit's only new cost is one None check (flags-off pin)
+        from ..core.flags import get_flag
+        self._split_enabled = bool(get_flag("serve_traffic_split"))
+        self._split = None
+        self._lifecycle = None
+        #: shadow mirrors live OUTSIDE _records: discarded traffic must
+        #: never count toward fleet availability or duplicate ids
+        self._shadow_records: Dict[int, _RouterRecord] = {}
+        self._divergence_pending: List[int] = []
         self._lock = threading.RLock()
         self._threads: List[threading.Thread] = []
         self._stop_evt = threading.Event()
@@ -255,9 +275,13 @@ class FleetRouter:
                 return rep
         return None
 
-    def _route(self, prompt,
-               info: Optional[dict] = None) -> Optional[ReplicaHandle]:
+    def _route(self, prompt, info: Optional[dict] = None,
+               exclude: Optional[str] = None) -> Optional[ReplicaHandle]:
         affine = self._affine_replica(prompt)
+        if affine is not None and affine.name == exclude:
+            # baseline-arm traffic keeps off the candidate replica
+            # during a bake; its keys spill like a not-ready owner's
+            affine = None
         if info is not None:       # tracing-only route-decision detail
             info["affinity_key"] = \
                 f"{self._hash(self._affinity_key(prompt)):016x}"
@@ -274,7 +298,11 @@ class FleetRouter:
             info["route"] = "balanced"
             if affine is not None:
                 info["fallback"] = "saturation"
-        ready = [r for r in self.replicas.values() if self._ready(r)]
+        ready = [r for r in self.replicas.values()
+                 if self._ready(r) and r.name != exclude]
+        if not ready and exclude is not None:
+            # fail open: an excluded candidate beats a shed request
+            ready = [r for r in self.replicas.values() if self._ready(r)]
         if not ready:
             return None
         if len(ready) == 1:
@@ -291,6 +319,125 @@ class FleetRouter:
             "requests placed by the fleet router, by route "
             "kind").inc(route="balanced")
         return pick
+
+    # -- shadow/A-B traffic splitting (ISSUE 20) -----------------------------
+    def replica(self, name: str) -> Optional[ReplicaHandle]:
+        return self.replicas.get(name)
+
+    def attach_lifecycle(self, controller) -> None:
+        """Wire a :class:`~.lifecycle.LifecycleController`: the sweep
+        reports terminal split-arm outcomes to it and :meth:`step_all`
+        ticks its bake decision after each pass."""
+        self._lifecycle = controller
+
+    def set_traffic_split(self, split) -> None:
+        """Arm a :class:`~.lifecycle.TrafficSplit`: live traffic
+        hash-splits between the baseline replicas and the candidate
+        (``ab_frac``) and/or mirrors onto the candidate with the
+        mirror's response discarded but fully measured
+        (``shadow_frac``). Requires ``FLAGS_serve_traffic_split`` (read
+        once at router construction)."""
+        if not self._split_enabled:
+            raise RuntimeError(
+                "FLAGS_serve_traffic_split is off — traffic splitting "
+                "is disarmed for this router (the flag is read once at "
+                "construction)")
+        if split.candidate not in self.replicas:
+            raise ValueError(
+                f"traffic split candidate {split.candidate!r} is not a "
+                f"replica ({sorted(self.replicas)})")
+        self._split = split
+        safe_record_event("traffic_split_set",
+                          candidate=split.candidate,
+                          ab_frac=split.ab_frac,
+                          shadow_frac=split.shadow_frac)
+
+    def clear_traffic_split(self) -> None:
+        if self._split is not None:
+            safe_record_event("traffic_split_cleared",
+                              candidate=self._split.candidate)
+        self._split = None
+
+    def _mirror_shadow(self, rec: _RouterRecord, request: Request,
+                       split) -> None:
+        """Submit a shadow copy of a just-placed baseline request to
+        the candidate replica. The mirror has no client callbacks (its
+        response is discarded), its own request id, and its own record
+        OUTSIDE the availability books; a refusal drops the mirror
+        silently — shadow load must never shed live traffic."""
+        cand = self.replicas.get(split.candidate)
+        if cand is None or not self._ready(cand):
+            return
+        mirror = Request(
+            prompt=np.asarray(rec.prompt, np.int32),
+            max_new_tokens=rec.max_new_tokens,
+            sampling=request.sampling,
+            eos_token_id=request.eos_token_id,
+            priority=request.priority,
+            deadline_s=request.deadline_s,
+            tenant=request.tenant,
+            adapter=request.adapter)
+        srec = _RouterRecord(
+            request_id=int(mirror.request_id),
+            prompt=list(rec.prompt),
+            max_new_tokens=rec.max_new_tokens,
+            sampling=request.sampling,
+            eos_token_id=request.eos_token_id,
+            priority=int(request.priority),
+            client_on_token=None, client_stop=None,
+            replica=cand.name, arm="shadow",
+            t_submit=self.clock(), shadow_of=rec.request_id)
+        mirror.on_token = self._tee(srec)
+        try:
+            srec.state = cand.submit(mirror)
+        except (ServerOverloaded, ValueError):
+            return
+        with self._lock:
+            self._shadow_records[srec.request_id] = srec
+            self._stats["shadow_mirrored"] += 1
+
+    def _observe_arm(self, rec: _RouterRecord, now: float) -> None:
+        """Per-arm accounting for one terminal record (only called on
+        arm-tagged records, so an un-split fleet emits none of these
+        series)."""
+        reg = get_registry()
+        reg.counter(
+            "serve_arm_requests_total",
+            "terminal split-arm outcomes during a lifecycle "
+            "bake").inc(arm=rec.arm, event=rec.outcome)
+        e2e = (now - rec.t_submit) if rec.t_submit else None
+        if e2e is not None:
+            reg.histogram(
+                "serve_arm_e2e_seconds",
+                "split-arm end-to-end latency (submit -> "
+                "terminal)").observe(e2e, arm=rec.arm)
+        if self._lifecycle is not None:
+            self._lifecycle.observe(rec.arm, rec.outcome, e2e, t=now)
+
+    def _check_divergence(self, srec: _RouterRecord) -> bool:
+        """Compare a terminal shadow mirror against its primary; True
+        when settled (primary terminal too, or gone). Only greedy
+        completed pairs count — sampled arms diverge by construction."""
+        primary = self._records.get(srec.shadow_of)
+        if primary is None:
+            return True
+        if not primary.done:
+            return False
+        if (srec.outcome == "completed"
+                and primary.outcome == "completed"
+                and srec.sampling.temperature == 0.0
+                and srec.tokens != primary.tokens):
+            self._stats["shadow_divergence"] += 1
+            get_registry().counter(
+                "serve_shadow_divergence_total",
+                "greedy shadow mirrors whose token stream diverged "
+                "from their primary's").inc()
+            safe_record_event("shadow_divergence",
+                              request_id=primary.request_id,
+                              shadow_id=srec.request_id,
+                              primary_tokens=len(primary.tokens),
+                              shadow_tokens=len(srec.tokens))
+        return True
 
     # -- submission ---------------------------------------------------------
     def _tee(self, rec: _RouterRecord) -> Callable:
@@ -324,7 +471,29 @@ class FleetRouter:
                 tenant=request.tenant)
             route_sp = tr.start_span("route", t=t0)
             info = {}
-        rep = self._route(request.prompt, info)
+        split = self._split
+        arm = None
+        if split is not None:
+            from .lifecycle import assign_arm, should_shadow
+            arm = assign_arm(int(request.request_id), split.seed,
+                             split.ab_frac)
+        if arm == "candidate":
+            # the A/B arm lives on the candidate replica; a not-ready
+            # candidate fails open to the baseline (availability first)
+            cand = self.replicas.get(split.candidate)
+            if cand is not None and self._ready(cand):
+                rep = cand
+                if info is not None:
+                    info["route"] = "ab_candidate"
+            else:
+                arm = "baseline"
+                rep = self._route(request.prompt, info,
+                                  exclude=split.candidate)
+        elif arm == "baseline":
+            rep = self._route(request.prompt, info,
+                              exclude=split.candidate)
+        else:
+            rep = self._route(request.prompt, info)
         dt = self.clock() - t0
         self._route_lat.append(dt)
         get_registry().histogram(
@@ -353,7 +522,7 @@ class FleetRouter:
             priority=int(request.priority),
             client_on_token=request.on_token,
             client_stop=request.stop,
-            replica=rep.name,
+            replica=rep.name, arm=arm, t_submit=t0,
             trace=tr, trace_parent=request.trace_parent)
         request.on_token = self._tee(rec)
         try:
@@ -391,6 +560,11 @@ class FleetRouter:
                         else request.trace_id)
         with self._lock:
             self._records[rec.request_id] = rec
+        if (arm == "baseline" and split.shadow_frac > 0.0
+                and rep.name != split.candidate
+                and should_shadow(rec.request_id, split.seed,
+                                  split.shadow_frac)):
+            self._mirror_shadow(rec, request, split)
         return rec
 
     def _reject(self) -> None:
@@ -572,6 +746,7 @@ class FleetRouter:
         """Fold engine-side completions into the router's records,
         close each finished record's fleet trace (terminal failures
         tail-retain it), and refresh the fleet gauges."""
+        now = self.clock()
         with self._lock:
             for rec in self._records.values():
                 st = rec.state
@@ -580,6 +755,8 @@ class FleetRouter:
                     rec.done = True
                     rec.outcome = st.outcome
                     rec.state = None
+                    if rec.arm is not None:
+                        self._observe_arm(rec, now)
                 if rec.done and rec.trace is not None:
                     tr = rec.trace
                     rec.trace = None
@@ -588,6 +765,24 @@ class FleetRouter:
                     if rec.outcome in _trace.ANOMALY_REASONS:
                         tr.mark_anomaly(rec.outcome)
                     _trace.get_tracer().finish_trace(tr)
+            # shadow mirrors fold the same way but into their own
+            # books; a terminal mirror whose primary is still in
+            # flight re-checks divergence on later sweeps
+            for sid, srec in self._shadow_records.items():
+                st = srec.state
+                if not srec.done and st is not None \
+                        and st.outcome in _TERMINAL_OUTCOMES:
+                    srec.done = True
+                    srec.outcome = st.outcome
+                    srec.state = None
+                    self._observe_arm(srec, now)
+                    if not self._check_divergence(srec):
+                        self._divergence_pending.append(sid)
+            if self._divergence_pending:
+                self._divergence_pending = [
+                    sid for sid in self._divergence_pending
+                    if not self._check_divergence(
+                        self._shadow_records[sid])]
 
     def step_all(self) -> bool:
         """One synchronous round-robin pass over the live replicas.
@@ -598,6 +793,10 @@ class FleetRouter:
                 rep.step()
                 worked = True
         self._sweep()
+        if self._lifecycle is not None:
+            # bake-decision tick outside the record lock: a decision
+            # touches replica engines (rollback/promotion swaps)
+            self._lifecycle.maybe_decide()
         return worked
 
     def run(self, max_steps: Optional[int] = None) -> None:
@@ -731,8 +930,15 @@ class FleetRouter:
                 busy.append(rep.busy_s)
         with self._lock:
             recs = list(self._records.values())
+            shadow_recs = list(self._shadow_records.values())
             stats = dict(self._stats)
             lat = sorted(self._route_lat)
+        arm_requests: Dict[str, int] = {}
+        for r in recs:
+            if r.arm is not None:
+                arm_requests[r.arm] = arm_requests.get(r.arm, 0) + 1
+        if shadow_recs:
+            arm_requests["shadow"] = len(shadow_recs)
         completed = sum(1 for r in recs
                         if r.done and r.outcome == "completed")
         failed = sum(1 for r in recs
@@ -773,6 +979,15 @@ class FleetRouter:
             "migration_failed": stats["migration_failed"],
             "route_overhead_p50_s": q(0.50),
             "route_overhead_p99_s": q(0.99),
+            # model lifecycle (ISSUE 20); all zero/empty off a bake
+            "arm_requests": arm_requests,
+            "shadow_mirrored": stats["shadow_mirrored"],
+            "shadow_divergence": stats["shadow_divergence"],
+            "traffic_split": (
+                {"candidate": self._split.candidate,
+                 "ab_frac": self._split.ab_frac,
+                 "shadow_frac": self._split.shadow_frac}
+                if self._split is not None else None),
         }
 
     def shutdown(self) -> None:

@@ -153,6 +153,14 @@ class RequestState:
         #: length; chunked prefill advances it per chunk. Reset on
         #: preemption (the pages are gone).
         self.prefill_pos = 0
+        #: weights generation this residency's KV pages were written
+        #: with (ISSUE 20): stamped by the engine at the first prefill
+        #: chunk, so a slot in flight across a hot swap keeps decoding
+        #: on the SAME tree its pages came from. None = not stamped
+        #: yet (next prefill uses the engine's live epoch). Reset on
+        #: preemption — the pages are gone and the re-prefill writes
+        #: fresh ones with the then-live weights.
+        self.weights_epoch: Optional[int] = None
         #: effective-prompt length this residency must prefill (set at
         #: admission — effective_prompt() grows as tokens generate, so
         #: the target is stamped, not recomputed)
@@ -707,6 +715,7 @@ class Scheduler:
         st.admitted_t = None
         st.prefill_pos = 0
         st.prefill_len = None
+        st.weights_epoch = None
         st.draft = []
         if count:
             st.preemptions += 1

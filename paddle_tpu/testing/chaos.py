@@ -85,6 +85,23 @@ SITES: Dict[str, str] = {
                            "preempted",
     "serve.detok.raise": "raise from the streaming detokenizer/on_token "
                          "callback of one accepted token",
+    # model-lifecycle sites (ISSUE 20; probed by serving/engine.py +
+    # serving/lifecycle.py — built in so `bench.py --chaos` can arm
+    # them before the serving import)
+    "serve.swap.torn_manifest": "a candidate weight push reads as torn "
+                                "at verification time: swap_weights "
+                                "must refuse it and the OLD weights "
+                                "keep serving",
+    "serve.swap.bad_weights": "plant non-finite values into a loaded "
+                              "candidate param tree AFTER verification "
+                              "(the corruption manifests as NaN logits "
+                              "in flight — the auto-rollback drill)",
+    "serve.swap.replica_die_mid_swap": "the candidate replica dies "
+                                       "while its swap is staged: the "
+                                       "lifecycle controller must "
+                                       "abort, migrate its in-flight "
+                                       "work and leave the baseline "
+                                       "untouched",
 }
 
 

@@ -61,9 +61,14 @@ _ABS_POINT_UNITS = {"shed%", "bubble%", "exposed%", "drop%",
 # goodput% is the training goodput ledger's productive share
 # (BENCH_train, train_goodput_pct): a drop means wall-clock leaked into
 # a badput bucket — a point loss is a point loss whether the baseline
-# sat at 99 or at 60, so absolute points again.
+# sat at 99 or at 60, so absolute points again. swap% is the
+# hot-swap-drill availability (BENCH_serve, serve_swap_availability_pct:
+# fleet availability through 3 consecutive live weight swaps under mmpp
+# load): it lives at ~100 where the relative band would hide a 9-point
+# outage, so absolute points — a drop means the zero-downtime cutover
+# started shedding or failing live requests.
 _ABS_POINT_HIGHER_UNITS = {"weak%", "balance", "hit%", "accept%",
-                           "goodput%"}
+                           "goodput%", "swap%"}
 # recsys rate-like units (BENCH_recsys) ride the default direction:
 # examples/s (training/serving throughput) and ratio (dedup ratio —
 # mean ids served per row fetched, >= 1) are higher-is-better relative,

@@ -46,6 +46,13 @@ stream — prints:
   burn rate per window (1.0 = spending exactly the budget; rendered
   next to --serve, which tells you *what* is failing while this tells
   you *how fast the budget goes*);
+- with ``--lifecycle``: the zero-downtime model-push view — hot-swap
+  event counters (``serve_swaps_total``), the live weights epoch and
+  promotion-controller state, the state/epoch timeline from repeated
+  dumps, per-arm shadow/A-B outcomes + latency and greedy
+  shadow-divergence counts (``serve_lifecycle_*``/``serve_arm_*``
+  series from paddle_tpu.serving.lifecycle; docs/SERVING.md "Model
+  lifecycle"; rendered next to --serve/--slo);
 - with ``--goodput``: the training goodput view — the
   ``train_goodput_pct`` gauge, cumulative badput seconds by exclusive
   bucket (``train_badput_seconds_total``), and the per-layer model
@@ -82,7 +89,7 @@ tree with per-span duration, EXCLUSIVE time and the critical path
 (docs/OBSERVABILITY.md "Structured tracing").
 
 Usage:
-    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--fleet] [--slo] [--goodput] [--comms] [--moe] [--recsys] [--fallbacks]
+    python tools/monitor_report.py BENCH_monitor.jsonl [--top 10] [--memory] [--serve] [--fleet] [--slo] [--lifecycle] [--goodput] [--comms] [--moe] [--recsys] [--fallbacks]
     python tools/monitor_report.py --flight flight_recorder_123.json [--last 20]
     python tools/monitor_report.py --trace traces.json [--last 20]
     python tools/monitor_report.py --kernels
@@ -423,6 +430,150 @@ def _slo_section(latest, used) -> List[str]:
                "(no slo_* gauges in this dump — arm "
                "ServingConfig.slo_availability / slo_deadline, or call "
                "SLOTracker.publish())", ""]
+    return out
+
+
+#: lifecycle-state gauge codes (serve_lifecycle_state) — fallback copy
+#: for a standalone checkout; the live tuple is
+#: paddle_tpu.serving.lifecycle.STATES and a sync-pin test keeps them
+#: from drifting
+_LIFECYCLE_STATES_FALLBACK = ("serving", "staging", "baking", "promoted",
+                              "rolled-back")
+
+
+def _lifecycle_states() -> tuple:
+    try:
+        from paddle_tpu.serving.lifecycle import STATES
+        return tuple(STATES)
+    except Exception:
+        return _LIFECYCLE_STATES_FALLBACK
+
+
+def _lifecycle_timeline(rows: List[dict], used) -> List[str]:
+    """Controller-state timeline from EVERY serve_lifecycle_state and
+    serve_weights_epoch sample in the (append-only) dump, in file
+    order — repeated registry dumps trace a staged push through
+    staging -> baking -> promoted (or rolled-back), interleaved with
+    the epoch bumps of each cutover."""
+    states = _lifecycle_states()
+    samples = [r for r in rows
+               if r.get("name") in ("serve_lifecycle_state",
+                                    "serve_weights_epoch")]
+    if not samples:
+        return []
+    t0 = next((r["ts"] for r in samples
+               if isinstance(r.get("ts"), (int, float))), None)
+    out, last = [], {}
+    for r in samples:
+        name = r["name"]
+        used.add((name, tuple(sorted((r.get("labels") or {}).items()))))
+        v = r.get("value")
+        if name == "serve_lifecycle_state":
+            code = int(v or 0)
+            what = (states[code] if 0 <= code < len(states)
+                    else f"state {code}")
+        else:
+            what = f"weights epoch -> {v:g}" if v is not None else "-"
+        if last.get(name) == what:
+            continue
+        last[name] = what
+        ts = r.get("ts")
+        rel = (f"+{ts - t0:.2f}s"
+               if isinstance(ts, (int, float)) and t0 is not None
+               else "-")
+        out.append([rel, what])
+    return _table("Lifecycle timeline", ["t", "event"], out)
+
+
+def _lifecycle_section(latest, used,
+                       raw_rows: Optional[List[dict]] = None) -> List[str]:
+    """--lifecycle: the zero-downtime model-push view (docs/SERVING.md
+    "Model lifecycle") — hot-swap event counters
+    (``serve_swaps_total{event}``), the live weights epoch and
+    controller state, the state/epoch timeline, per-arm shadow/A-B
+    outcomes + latency (``serve_arm_*``) and greedy shadow-divergence
+    counts, plus the candidate's burn gauges when an SLOTracker named
+    ``lifecycle_*`` published (peeked, not claimed — ``--slo`` still
+    renders the full burn table). Rendered next to --serve/--slo."""
+    states = _lifecycle_states()
+    swap_rows, s_rows = [], []
+    arm_counts: Dict[str, Dict[str, float]] = {}
+    arm_lat: Dict[str, dict] = {}
+    divergence = None
+    for key in sorted(latest):
+        name, labels = key
+        row = latest[key]
+        d = dict(labels)
+        if name == "serve_swaps_total":
+            used.add(key)
+            swap_rows.append([str(d.get("event", "-")),
+                              f"{row.get('value', 0):g}"])
+        elif name == "serve_weights_epoch":
+            used.add(key)
+            s_rows.append(["live weights epoch",
+                           f"{row.get('value', 0):g}"])
+        elif name == "serve_lifecycle_state":
+            used.add(key)
+            code = int(row.get("value") or 0)
+            s_rows.append(["controller state",
+                           states[code] if 0 <= code < len(states)
+                           else f"state {code}"])
+        elif name == "serve_lifecycle_transitions_total":
+            used.add(key)
+            s_rows.append([f"transitions -> {d.get('to', '-')}",
+                           f"{row.get('value', 0):g}"])
+        elif name == "serve_arm_requests_total":
+            used.add(key)
+            arm_counts.setdefault(str(d.get("arm", "-")), {})[
+                str(d.get("event", "-"))] = row.get("value", 0.0)
+        elif name == "serve_arm_e2e_seconds":
+            used.add(key)
+            arm_lat[str(d.get("arm", "-"))] = row
+        elif name == "serve_shadow_divergence_total":
+            used.add(key)
+            divergence = row.get("value", 0.0)
+    out = _table("Lifecycle (hot-swap push state)",
+                 ["what", "value"], s_rows)
+    out += _table("Weight-swap events (serve_swaps_total)",
+                  ["event", "count"], swap_rows)
+    a_rows = []
+    for arm in sorted(set(arm_counts) | set(arm_lat)):
+        counts = arm_counts.get(arm, {})
+        lat = arm_lat.get(arm)
+        n = int(lat.get("count") or 0) if lat else 0
+        mean = (lat["sum"] / n * 1e3) if lat and n else 0.0
+        p99 = _hist_pct(lat, 0.99) if lat else None
+        a_rows.append(
+            [arm, f"{sum(counts.values()):g}",
+             ",".join(f"{e}={v:g}" for e, v in sorted(counts.items()))
+             or "-",
+             f"{mean:,.2f}" if n else "-",
+             f"<= {p99 * 1e3:,.1f}" if p99 is not None else "-"])
+    out += _table("Shadow/A-B arms",
+                  ["arm", "requests", "outcomes", "mean e2e ms",
+                   "~p99 ms"], a_rows)
+    if divergence is not None:
+        out += [f"  greedy shadow divergences: {divergence:g}", ""]
+    # candidate burn at a glance — peek the lifecycle_* SLO gauges
+    # WITHOUT used.add so --slo (rendered before this section) keeps
+    # its full table and the generic tables stay deduplicated there
+    b_rows = []
+    for key in sorted(latest):
+        name, labels = key
+        d = dict(labels)
+        if (name == "slo_burn_rate"
+                and str(d.get("slo", "")).startswith("lifecycle")):
+            b_rows.append([str(d.get("slo")), str(d.get("window", "?")),
+                           f"{latest[key].get('value', 0.0):.2f}"])
+    out += _table("Candidate burn (slo_burn_rate, 1.0 = on budget)",
+                  ["slo", "window", "burn"], b_rows)
+    out += _lifecycle_timeline(raw_rows or [], used)
+    if not out:
+        out = ["== Lifecycle ==",
+               "(no serve_swaps_total / serve_lifecycle_* metrics in "
+               "this dump — enable FLAGS_serve_hot_swap and push a "
+               "manifest through ServingEngine.swap_weights or "
+               "LifecycleController.begin first)", ""]
     return out
 
 
@@ -1025,7 +1176,8 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
            serve: bool = False, comms: bool = False,
            moe: bool = False, fallbacks: bool = False,
            recsys: bool = False, slo: bool = False,
-           fleet: bool = False, goodput: bool = False) -> str:
+           fleet: bool = False, goodput: bool = False,
+           lifecycle: bool = False) -> str:
     latest = _latest_samples(rows)
     used = set()
 
@@ -1039,6 +1191,10 @@ def render(rows: List[dict], top: int = 10, memory: bool = False,
                   if serve else [])
     # -- SLO burn (--slo) renders next to --serve ------------------------
     serve_out += _slo_section(latest, used) if slo else []
+    # -- model lifecycle (--lifecycle) renders AFTER --slo so the burn
+    # table keeps every slo_* gauge (this section only peeks them) -------
+    serve_out += (_lifecycle_section(latest, used, raw_rows=rows)
+                  if lifecycle else [])
     # -- training goodput (--goodput) claims the train_* ledger series
     # before the generic counter tables ----------------------------------
     serve_out += _goodput_section(latest, used) if goodput else []
@@ -1197,6 +1353,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo = "--slo" in argv
     if slo:
         argv.remove("--slo")
+    lifecycle = "--lifecycle" in argv
+    if lifecycle:
+        argv.remove("--lifecycle")
     goodput = "--goodput" in argv
     if goodput:
         argv.remove("--goodput")
@@ -1238,7 +1397,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     print(render(rows, top=top, memory=memory, serve=serve, comms=comms,
                  moe=moe, fallbacks=fallbacks, recsys=recsys, slo=slo,
-                 fleet=fleet, goodput=goodput),
+                 fleet=fleet, goodput=goodput, lifecycle=lifecycle),
           end="")
     return 0
 
